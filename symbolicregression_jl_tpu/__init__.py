@@ -1,0 +1,44 @@
+"""symbolicregression_jl_tpu — a TPU-native symbolic regression framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+SymbolicRegression.jl: tensorized populations, a vmapped postfix tree
+interpreter, device-side regularized evolution, batched constant
+optimization via `jax.grad`, and island parallelism over
+`jax.sharding.Mesh` devices.
+"""
+
+__version__ = "0.1.0"
+
+from .core.dataset import Dataset, make_dataset
+from .core.losses import LOSS_REGISTRY, resolve_loss
+from .core.options import ComplexityMapping, MutationWeights, Options
+from .ops.operators import Op, OperatorSet
+from .ops.tree import Node, parse_expression, string_tree
+
+__all__ = [
+    "Dataset",
+    "make_dataset",
+    "Options",
+    "MutationWeights",
+    "ComplexityMapping",
+    "Op",
+    "OperatorSet",
+    "Node",
+    "parse_expression",
+    "string_tree",
+    "LOSS_REGISTRY",
+    "resolve_loss",
+]
+
+
+def __getattr__(name):
+    # Lazily expose the heavier API surface to keep import light.
+    if name in ("equation_search", "SearchState", "RuntimeOptions"):
+        from .api import search
+
+        return getattr(search, name)
+    if name in ("SRRegressor", "MultitargetSRRegressor"):
+        from .api import regressor
+
+        return getattr(regressor, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

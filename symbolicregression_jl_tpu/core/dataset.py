@@ -1,0 +1,196 @@
+"""Device-resident datasets (TPU analogue of src/Dataset.jl).
+
+The full dataset lives in HBM for the whole search; minibatching
+(`SubDataset`, /root/reference/src/Dataset.jl:90-115) becomes gathered
+index subsets produced inside the jitted generation step, so the eval
+kernel always sees static shapes.
+
+Public layout is sklearn-style ``X: (n, nfeatures)``; internally we store
+the transpose ``Xt: (nfeatures, n)`` so the interpreter's feature lookup is
+a contiguous row gather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Dataset", "make_dataset"]
+
+
+def _subscriptify(i: int) -> str:
+    subs = "₀₁₂₃₄₅₆₇₈₉"
+    return "".join(subs[int(c)] for c in str(i))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DeviceData:
+    """The pytree part of a Dataset (device arrays)."""
+
+    Xt: jax.Array  # [nfeatures, n]
+    y: Optional[jax.Array]  # [n]
+    weights: Optional[jax.Array]  # [n] or None
+    class_idx: Optional[jax.Array]  # [n] int32 or None (parametric expressions)
+    baseline_loss: jax.Array  # scalar
+    use_baseline: jax.Array  # bool scalar
+
+
+@dataclasses.dataclass
+class Dataset:
+    """Host wrapper: device data + metadata.
+
+    Mirrors `BasicDataset` fields (/root/reference/src/Dataset.jl:53-82):
+    variable names, units, average y, baseline loss. ``extra`` carries
+    additional columns (e.g. ``class`` for ParametricExpression).
+    """
+
+    data: DeviceData
+    n: int
+    nfeatures: int
+    index: int = 1
+    avg_y: Optional[float] = None
+    variable_names: Sequence[str] = ()
+    display_variable_names: Sequence[str] = ()
+    y_variable_name: str = "y"
+    X_units: Optional[Sequence[str]] = None
+    y_units: Optional[str] = None
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def X(self):
+        return self.data.Xt.T
+
+    @property
+    def y(self):
+        return self.data.y
+
+    @property
+    def weights(self):
+        return self.data.weights
+
+    @property
+    def is_weighted(self) -> bool:
+        return self.data.weights is not None
+
+    @property
+    def has_units(self) -> bool:
+        return self.X_units is not None or self.y_units is not None
+
+    @property
+    def n_classes(self) -> int:
+        if self.data.class_idx is None:
+            return 0
+        return int(np.asarray(self.data.class_idx).max()) + 1
+
+    def update_baseline_loss(self, elementwise_loss) -> None:
+        """Evaluate the constant (avg-y) predictor to set the baseline
+        (update_baseline_loss!, /root/reference/src/LossFunctions.jl:219-234)."""
+        from .losses import aggregate_loss
+
+        if self.data.y is None or self.avg_y is None:
+            return
+        pred = jnp.full_like(self.data.y, jnp.asarray(self.avg_y, self.data.y.dtype))
+        loss = aggregate_loss(
+            elementwise_loss, pred, self.data.y, jnp.bool_(True), self.data.weights
+        )
+        loss_f = float(loss)
+        if np.isfinite(loss_f):
+            self.data = dataclasses.replace(
+                self.data,
+                baseline_loss=jnp.asarray(loss_f, self.data.baseline_loss.dtype),
+                use_baseline=jnp.bool_(True),
+            )
+        else:
+            self.data = dataclasses.replace(
+                self.data,
+                baseline_loss=jnp.ones_like(self.data.baseline_loss),
+                use_baseline=jnp.bool_(False),
+            )
+
+
+def make_dataset(
+    X,
+    y=None,
+    *,
+    weights=None,
+    variable_names: Optional[Sequence[str]] = None,
+    display_variable_names: Optional[Sequence[str]] = None,
+    y_variable_name: Optional[str] = None,
+    extra: Optional[Dict[str, Any]] = None,
+    X_units=None,
+    y_units=None,
+    index: int = 1,
+    dtype=None,
+) -> Dataset:
+    """Construct a Dataset from ``X: (n, nfeatures)`` and ``y: (n,)``.
+
+    (Note the transposed convention vs the reference's ``(nfeatures, n)`` —
+    this follows sklearn/PySR's user-facing layout.)
+    """
+    X = np.asarray(X)
+    if X.ndim != 2:
+        raise ValueError(f"X must be 2D (n, nfeatures); got shape {X.shape}")
+    if dtype is None:
+        dtype = X.dtype if X.dtype in (np.float32, np.float64) else np.float32
+    n, nfeatures = X.shape
+    y_arr = None if y is None else np.asarray(y, dtype).reshape(-1)
+    if y_arr is not None and y_arr.shape[0] != n:
+        raise ValueError(f"y has {y_arr.shape[0]} rows but X has {n}")
+    w_arr = None if weights is None else np.asarray(weights, dtype).reshape(-1)
+    if w_arr is not None and w_arr.shape[0] != n:
+        raise ValueError(f"weights has {w_arr.shape[0]} rows but X has {n}")
+    extra = dict(extra or {})
+    class_idx = None
+    if "class" in extra or "classes" in extra:
+        cls = np.asarray(extra.get("class", extra.get("classes"))).reshape(-1)
+        uniq = np.unique(cls)
+        class_idx = jnp.asarray(np.searchsorted(uniq, cls).astype(np.int32))
+        extra["class"] = cls
+
+    variable_names = list(
+        variable_names or [f"x{i + 1}" for i in range(nfeatures)]
+    )
+    display_variable_names = list(
+        display_variable_names
+        or (
+            variable_names
+            if variable_names != [f"x{i + 1}" for i in range(nfeatures)]
+            else [f"x{_subscriptify(i + 1)}" for i in range(nfeatures)]
+        )
+    )
+    if y_variable_name is None:
+        y_variable_name = "y" if "y" not in variable_names else "target"
+
+    avg_y = None
+    if y_arr is not None:
+        if w_arr is not None:
+            avg_y = float(np.sum(y_arr * w_arr) / np.sum(w_arr))
+        else:
+            avg_y = float(np.mean(y_arr))
+
+    data = DeviceData(
+        Xt=jnp.asarray(X.T.astype(dtype)),
+        y=None if y_arr is None else jnp.asarray(y_arr),
+        weights=None if w_arr is None else jnp.asarray(w_arr),
+        class_idx=class_idx,
+        baseline_loss=jnp.asarray(1.0, dtype),
+        use_baseline=jnp.bool_(True),
+    )
+    return Dataset(
+        data=data,
+        n=n,
+        nfeatures=nfeatures,
+        index=index,
+        avg_y=avg_y,
+        variable_names=variable_names,
+        display_variable_names=display_variable_names,
+        y_variable_name=y_variable_name,
+        X_units=list(X_units) if X_units is not None else None,
+        y_units=y_units,
+        extra=extra,
+    )

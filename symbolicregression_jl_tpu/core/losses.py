@@ -1,0 +1,135 @@
+"""Losses and cost computation (TPU analogue of src/LossFunctions.jl).
+
+`elementwise_loss` takes ``(prediction, target)`` (or ``(prediction,
+target, weight)`` for user functions that consume weights directly) and
+returns elementwise values. The framework aggregates:
+unweighted = mean; weighted = sum(loss * w) / sum(w)
+(/root/reference/src/LossFunctions.jl:38-58). Invalid evaluation =>
+``inf`` loss (:96-99). `loss_to_cost` adds baseline normalization and the
+parsimony complexity penalty (:170-190).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+
+__all__ = ["resolve_loss", "aggregate_loss", "loss_to_cost", "LOSS_REGISTRY"]
+
+
+def l2_dist_loss(pred, target):
+    d = pred - target
+    return d * d
+
+
+def l1_dist_loss(pred, target):
+    return jnp.abs(pred - target)
+
+
+def huber_loss(delta: float = 1.0):
+    def f(pred, target):
+        a = jnp.abs(pred - target)
+        return jnp.where(a <= delta, 0.5 * a * a, delta * (a - 0.5 * delta))
+
+    return f
+
+
+def log_cosh_loss(pred, target):
+    return jnp.logaddexp(pred - target, target - pred) - jnp.log(2.0).astype(pred.dtype)
+
+
+def logit_dist_loss(pred, target):
+    # LossFunctions.jl LogitDistLoss(d) = -log(4 e^d / (1+e^d)^2) = 2 log(cosh(d/2))
+    d = pred - target
+    return 2.0 * (jnp.logaddexp(d / 2, -d / 2) - jnp.log(2.0).astype(d.dtype))
+
+
+def sigmoid_cross_entropy_loss(pred, target):
+    # target in {0,1}; pred is a logit
+    return jnp.maximum(pred, 0) - pred * target + jnp.log1p(jnp.exp(-jnp.abs(pred)))
+
+
+def periodic_l2_loss(c: float = 2 * 3.141592653589793):
+    def f(pred, target):
+        d = jnp.mod(pred - target + c / 2, c) - c / 2
+        return d * d
+
+    return f
+
+
+LOSS_REGISTRY = {
+    # LossFunctions.jl-compatible names (the reference's default is
+    # L2DistLoss(), src/Options.jl:772):
+    "L2DistLoss": l2_dist_loss,
+    "L1DistLoss": l1_dist_loss,
+    "LogitDistLoss": logit_dist_loss,
+    "HuberLoss": huber_loss(1.0),
+    # Friendly names:
+    "mse": l2_dist_loss,
+    "l2": l2_dist_loss,
+    "mae": l1_dist_loss,
+    "l1": l1_dist_loss,
+    "huber": huber_loss(1.0),
+    "logcosh": log_cosh_loss,
+}
+
+
+def resolve_loss(spec: Union[str, Callable, None]) -> Callable:
+    if spec is None:
+        return l2_dist_loss
+    if callable(spec):
+        return spec
+    name = str(spec).replace("()", "")
+    if name in LOSS_REGISTRY:
+        return LOSS_REGISTRY[name]
+    raise ValueError(f"Unknown loss {spec!r}; pass a callable (pred, target) -> elementwise loss")
+
+
+def aggregate_loss(
+    elementwise: Callable,
+    pred: jnp.ndarray,  # [..., n]
+    target: jnp.ndarray,  # [n]
+    valid,  # bool [...]
+    weights: Optional[jnp.ndarray] = None,  # [n]
+    row_mask: Optional[jnp.ndarray] = None,  # bool [n] (for padded/batched rows)
+):
+    """Mean (or weighted-mean) loss with invalid -> inf.
+
+    ``row_mask`` allows evaluating on a masked subset of rows (used by
+    minibatching where batches are gathered index subsets).
+    """
+    vals = elementwise(pred, target)
+    # Guard against NaN*0: zero out masked rows explicitly.
+    if weights is None and row_mask is None:
+        loss = jnp.mean(vals, axis=-1)
+    else:
+        w = jnp.ones_like(target) if weights is None else weights
+        if row_mask is not None:
+            w = w * row_mask.astype(w.dtype)
+        vals = jnp.where(jnp.isfinite(vals), vals, jnp.inf)
+        vals = jnp.where(w > 0, vals, 0.0)
+        loss = jnp.sum(vals * w, axis=-1) / jnp.sum(w)
+    inf = jnp.array(jnp.inf, dtype=loss.dtype)
+    loss = jnp.where(valid, loss, inf)
+    # NaN losses are treated as rejections downstream (src/Mutate.jl:273);
+    # normalize them to inf so cost ordering is well-defined.
+    return jnp.where(jnp.isnan(loss), inf, loss)
+
+
+def loss_to_cost(
+    loss,
+    baseline_loss,
+    use_baseline,
+    complexity,
+    parsimony: float,
+):
+    """cost = loss / max(baseline, 0.01) + parsimony * complexity.
+
+    Mirrors /root/reference/src/LossFunctions.jl:170-190 (normalization
+    floor of 0.01 when the baseline is unusable).
+    """
+    normalization = jnp.where(
+        use_baseline & (baseline_loss >= 0.01), baseline_loss, jnp.asarray(0.01, dtype=loss.dtype)
+    )
+    return loss / normalization + parsimony * complexity.astype(loss.dtype)

@@ -1,0 +1,351 @@
+"""The per-iteration engine: islands evolve, optimize, simplify, migrate.
+
+One call = one reference "iteration" for *all* islands at once
+(the reference dispatches each (output, population) pair to a worker,
+src/SymbolicRegression.jl:1253-1296; here the island axis is vmapped and
+sharded over the device mesh, so the whole iteration is one XLA program):
+
+    s_r_cycle (ncycles of bulk generation steps, annealing ramp)
+    -> optimize_and_simplify_population (constant folding + batched BFGS)
+    -> finalize costs (full-dataset re-eval when batching)
+    -> hall-of-fame merge across islands
+    -> migration (island <- best-sub-pops of all islands, island <- HoF)
+    -> running-statistics update (frequency histogram, windowing)
+
+Lineage ref rotation mirrors src/SingleIteration.jl:99-137.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dataset import DeviceData
+from ..core.losses import loss_to_cost
+from ..core.options import Options
+from ..ops.complexity import ComplexityTables, build_complexity_tables, \
+    compute_complexity_batch
+from ..ops.encoding import TreeBatch
+from .constant_opt import OptimizerConfig, optimize_constants_batch
+from .population import PopulationState, init_population
+from .simplify import fold_constants_batch
+from .step import (
+    EvolveConfig,
+    HofState,
+    empty_hof,
+    eval_cost_batch,
+    evolve_config_from_options,
+    s_r_cycle,
+    update_hof,
+)
+
+__all__ = ["SearchDeviceState", "Engine"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class RunningStats:
+    """RunningSearchStatistics (src/AdaptiveParsimony.jl:20-32)."""
+
+    frequencies: jax.Array            # [maxsize]
+    normalized_frequencies: jax.Array  # [maxsize]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SearchDeviceState:
+    """All device-resident search state (the SearchState analogue,
+    src/SearchUtils.jl:584-603, minus host bookkeeping)."""
+
+    pops: PopulationState   # leading island axis [I, P, ...]
+    hof: HofState           # global best-per-complexity [maxsize, ...]
+    stats: RunningStats
+    birth: jax.Array        # [I] int32 per-island birth counters
+    ref: jax.Array          # [I] int32 per-island lineage counters
+    num_evals: jax.Array    # scalar float32
+    key: jax.Array          # PRNG key
+
+
+def _move_window(freq, window_size: float, maxsize: int):
+    """Shrink frequencies toward 1 so they sum to window_size
+    (move_window!, src/AdaptiveParsimony.jl:55-87; smooth equivalent of the
+    reference's iterative uniform subtraction)."""
+    total = jnp.sum(freq)
+    excess_scale = (window_size - maxsize) / jnp.maximum(total - maxsize, 1e-9)
+    scaled = 1.0 + (freq - 1.0) * jnp.minimum(excess_scale, 1.0)
+    return jnp.where(total > window_size, scaled, freq)
+
+
+class Engine:
+    """Holds jitted computation for a fixed (options, dataset-shape) pair."""
+
+    def __init__(self, options: Options, nfeatures: int, dtype=jnp.float32,
+                 window_size: int = 100_000):
+        self.options = options
+        self.nfeatures = nfeatures
+        self.dtype = dtype
+        self.cfg: EvolveConfig = evolve_config_from_options(options, nfeatures)
+        self.tables: ComplexityTables = build_complexity_tables(options, nfeatures)
+        self.opt_cfg = OptimizerConfig(
+            iterations=options.optimizer_iterations,
+            nrestarts=options.optimizer_nrestarts,
+        )
+        self.window_size = float(window_size)
+        self._iteration = jax.jit(self._iteration_impl, donate_argnums=(0,))
+        self._init_state = jax.jit(self._init_state_impl, static_argnums=(2,))
+
+    # ------------------------------------------------------------------
+    def init_state(self, key, data: DeviceData, n_islands: int,
+                   initial_trees: Optional[TreeBatch] = None) -> SearchDeviceState:
+        return self._init_state(key, data, n_islands, initial_trees)
+
+    def _init_state_impl(self, key, data: DeviceData, n_islands: int,
+                         initial_trees: Optional[TreeBatch] = None):
+        cfg = self.cfg
+        P = cfg.population_size
+        k_init, k_state = jax.random.split(key)
+
+        if initial_trees is None:
+            keys = jax.random.split(k_init, n_islands)
+            trees = jax.vmap(
+                lambda k: init_population(k, P, cfg.mctx, self.dtype)
+            )(keys)
+        else:
+            trees = initial_trees
+
+        cost, loss, cx = jax.vmap(
+            lambda t: eval_cost_batch(
+                t, data, self.options.elementwise_loss, self.tables,
+                cfg.operators, cfg.parsimony,
+            )
+        )(trees)
+
+        pops = PopulationState(
+            trees=trees,
+            cost=cost,
+            loss=loss,
+            complexity=cx,
+            birth=jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (n_islands, P)),
+            ref=jnp.broadcast_to(
+                jnp.arange(P, dtype=jnp.int32), (n_islands, P)
+            ) + jnp.arange(n_islands, dtype=jnp.int32)[:, None] * 1_000_000,
+            parent=jnp.full((n_islands, P), -1, jnp.int32),
+        )
+        freq = jnp.ones((cfg.maxsize,), jnp.float32)
+        stats = RunningStats(
+            frequencies=freq, normalized_frequencies=freq / jnp.sum(freq)
+        )
+        return SearchDeviceState(
+            pops=pops,
+            hof=empty_hof(cfg.maxsize, cfg.max_nodes, self.dtype),
+            stats=stats,
+            birth=jnp.full((n_islands,), P, jnp.int32),
+            ref=jnp.full((n_islands,), P, jnp.int32),
+            num_evals=jnp.float32(n_islands * P),
+            key=k_state,
+        )
+
+    # ------------------------------------------------------------------
+    def run_iteration(self, state: SearchDeviceState, data: DeviceData,
+                      cur_maxsize: int):
+        return self._iteration(state, data, jnp.int32(cur_maxsize))
+
+    def _iteration_impl(self, state: SearchDeviceState, data: DeviceData,
+                        cur_maxsize):
+        cfg = self.cfg
+        options = self.options
+        tables = self.tables
+        el_loss = options.elementwise_loss
+        I = state.birth.shape[0]
+        P = cfg.population_size
+
+        key, k_batch, k_cycle, k_opt, k_mig = jax.random.split(state.key, 5)
+
+        # Minibatch indices: one batch per iteration, as in s_r_cycle
+        # (src/SingleIteration.jl:40).
+        batch_idx = None
+        if cfg.batching:
+            batch_idx = jax.random.randint(
+                k_batch, (cfg.batch_size,), 0, data.y.shape[0]
+            )
+        eval_fraction = (
+            cfg.batch_size / data.y.shape[0] if cfg.batching else 1.0
+        )
+
+        # ---- evolve all islands: ncycles bulk generation steps ----
+        cycle_keys = jax.random.split(k_cycle, I)
+
+        def island_cycle(k, pop, birth, ref):
+            return s_r_cycle(
+                k, pop, data, state.stats.normalized_frequencies, cur_maxsize,
+                birth, ref, cfg, options, tables, el_loss, batch_idx=batch_idx,
+            )
+
+        pops, best_seen, nev, birth, ref = jax.vmap(island_cycle)(
+            cycle_keys, state.pops, state.birth, state.ref
+        )
+        num_evals = state.num_evals + jnp.sum(nev) * eval_fraction
+
+        # ---- optimize & simplify (src/SingleIteration.jl:68-96) ----
+        if cfg.should_simplify:
+            folded = jax.vmap(
+                lambda t: fold_constants_batch(t, self.nfeatures, cfg.operators)
+            )(pops.trees)
+            pops = dataclasses.replace(pops, trees=folded)
+
+        if options.should_optimize_constants and options.optimizer_probability > 0:
+            ko1, ko2 = jax.random.split(k_opt)
+            do_opt = jax.random.bernoulli(
+                ko1, options.optimizer_probability, (I, P)
+            )
+            opt_keys = jax.random.split(ko2, I)
+
+            def island_opt(k, trees, do):
+                return optimize_constants_batch(
+                    k, trees, do, data, el_loss, cfg.operators, self.opt_cfg,
+                    batch_idx=batch_idx,
+                )
+            new_const, improved, _, f_calls = jax.vmap(island_opt)(
+                opt_keys, pops.trees, do_opt
+            )
+            pops = dataclasses.replace(
+                pops, trees=dataclasses.replace(pops.trees, const=new_const)
+            )
+            num_evals = num_evals + jnp.sum(f_calls) * eval_fraction
+
+        # ---- finalize costs on the full dataset (finalize_costs,
+        # src/Population.jl:182-196; always re-eval after simplify/opt) ----
+        cost, loss, cx = jax.vmap(
+            lambda t: eval_cost_batch(
+                t, data, el_loss, tables, cfg.operators, cfg.parsimony,
+            )
+        )(pops.trees)
+        pops = dataclasses.replace(pops, cost=cost, loss=loss, complexity=cx)
+        num_evals = num_evals + I * P
+
+        # Lineage rotation (src/SingleIteration.jl:99-104).
+        new_refs = ref[:, None] + jnp.arange(P, dtype=jnp.int32)[None, :]
+        pops = dataclasses.replace(pops, parent=pops.ref, ref=new_refs)
+        ref = ref + P
+
+        # ---- merge best_seen + final pops into the global HoF ----
+        hof = state.hof
+        flat_best = jax.tree.map(
+            lambda x: x.reshape((I * cfg.maxsize,) + x.shape[2:]), best_seen
+        )
+        hof = update_hof(
+            hof,
+            PopulationState(
+                trees=flat_best.trees,
+                cost=jnp.where(flat_best.exists, flat_best.cost, jnp.inf),
+                loss=flat_best.loss,
+                complexity=flat_best.complexity,
+                birth=jnp.zeros((I * cfg.maxsize,), jnp.int32),
+                ref=jnp.zeros((I * cfg.maxsize,), jnp.int32),
+                parent=jnp.zeros((I * cfg.maxsize,), jnp.int32),
+            ),
+            cfg.maxsize,
+        )
+        flat_pops = jax.tree.map(
+            lambda x: x.reshape((I * P,) + x.shape[2:]), pops
+        )
+        hof = update_hof(hof, flat_pops, cfg.maxsize)
+
+        # ---- migration (src/Migration.jl:15-37 + main loop :1071-1088) ----
+        if options.migration:
+            # Pool: topn members of each island (best_sub_pop,
+            # src/Population.jl:199-202), shared across islands. Under a
+            # sharded island axis XLA turns this reshape into an all_gather.
+            topn = min(options.topn, P)
+            order = jnp.argsort(pops.cost, axis=1)[:, :topn]  # [I, topn]
+            pool = jax.vmap(lambda p, o: p.member(o))(pops, order)
+            pool = jax.tree.map(
+                lambda x: x.reshape((I * topn,) + x.shape[2:]), pool
+            )
+            km1, km2, km3, km4 = jax.random.split(k_mig, 4)
+            pops, birth = _migrate(
+                km1, pops, pool, options.fraction_replaced, birth, I, P
+            )
+            if options.hof_migration:
+                hof_pool = PopulationState(
+                    trees=hof.trees,
+                    cost=jnp.where(hof.exists, hof.cost, jnp.inf),
+                    loss=hof.loss,
+                    complexity=hof.complexity,
+                    birth=jnp.zeros((cfg.maxsize,), jnp.int32),
+                    ref=jnp.zeros((cfg.maxsize,), jnp.int32),
+                    parent=jnp.zeros((cfg.maxsize,), jnp.int32),
+                )
+                pops, birth = _migrate(
+                    km2, pops, hof_pool, options.fraction_replaced_hof,
+                    birth, I, P, candidate_mask=hof.exists,
+                )
+
+        # ---- running stats update (head-node semantics:
+        # src/SymbolicRegression.jl:1054-1060 + move_window/normalize) ----
+        sizes = pops.complexity.reshape(-1)
+        in_range = (sizes > 0) & (sizes <= cfg.maxsize)
+        hist = jnp.zeros((cfg.maxsize,), jnp.float32).at[
+            jnp.where(in_range, sizes - 1, 0)
+        ].add(in_range.astype(jnp.float32))
+        freq = state.stats.frequencies + hist
+        freq = _move_window(freq, self.window_size, cfg.maxsize)
+        stats = RunningStats(
+            frequencies=freq,
+            normalized_frequencies=freq / jnp.sum(freq),
+        )
+
+        return SearchDeviceState(
+            pops=pops, hof=hof, stats=stats, birth=birth, ref=ref,
+            num_evals=num_evals, key=key,
+        )
+
+
+def _migrate(key, pops: PopulationState, pool: PopulationState, frac: float,
+             birth, I: int, P: int, candidate_mask=None):
+    """Replace each member with a random pool candidate w.p. `frac`
+    (binomial-per-member equivalent of the reference's Poisson count with
+    random positions, src/Migration.jl:20-35); birth reset to fresh ticks."""
+    if frac <= 0:
+        return pops, birth
+    k1, k2 = jax.random.split(key)
+    n_pool = pool.cost.shape[0]
+    replace = jax.random.bernoulli(k1, frac, (I, P))
+    if candidate_mask is not None:
+        # Sample only existing candidates.
+        logits = jnp.where(candidate_mask, 0.0, -jnp.inf)
+        pick = jax.random.categorical(k2, logits, shape=(I, P))
+        replace = replace & jnp.any(candidate_mask)
+    else:
+        pick = jax.random.randint(k2, (I, P), 0, n_pool)
+
+    picked = pool.member(pick.reshape(-1))
+    picked = jax.tree.map(
+        lambda x: x.reshape((I, P) + x.shape[1:]), picked
+    )
+
+    def sel(new, old):
+        shape = replace.shape + (1,) * (new.ndim - 2)
+        return jnp.where(replace.reshape(shape), new, old)
+
+    new_birth_ticks = birth[:, None] + jnp.arange(P, dtype=jnp.int32)[None, :]
+    out = PopulationState(
+        trees=TreeBatch(
+            arity=sel(picked.trees.arity, pops.trees.arity),
+            op=sel(picked.trees.op, pops.trees.op),
+            feat=sel(picked.trees.feat, pops.trees.feat),
+            const=sel(picked.trees.const, pops.trees.const),
+            length=sel(picked.trees.length, pops.trees.length),
+        ),
+        cost=sel(picked.cost, pops.cost),
+        loss=sel(picked.loss, pops.loss),
+        complexity=sel(picked.complexity, pops.complexity),
+        birth=jnp.where(replace, new_birth_ticks, pops.birth),
+        ref=sel(picked.ref, pops.ref),
+        parent=sel(picked.parent, pops.parent),
+    )
+    return out, birth + P

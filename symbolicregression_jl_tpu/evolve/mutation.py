@@ -1,0 +1,473 @@
+"""Device-side tree mutations on the postfix encoding.
+
+Each function mirrors one tree-edit primitive of
+/root/reference/src/MutationFunctions.jl, operating on a single unbatched
+tree (fields ``[L]``) — callers vmap over candidates and speculative
+attempts. Structural edits use the piece-concatenation gathers from
+:mod:`.pieces`; value edits are masked writes. Every function returns
+``(tree, ok)`` where ``ok=False`` marks a structurally impossible attempt
+(e.g. result would exceed the slot budget), which the generation step
+treats like a failed constraint check.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.encoding import (
+    LEAF_CONST,
+    LEAF_VAR,
+    MAX_ARITY,
+    TreeBatch,
+    _tree_structure_single,
+)
+from .pieces import combine_sources, concat_pieces, splice_span
+from .rng import masked_choice, randint_dyn
+
+__all__ = [
+    "MutationContext",
+    "mutate_constant",
+    "mutate_operator",
+    "mutate_feature",
+    "swap_operands",
+    "rotate_tree",
+    "add_node",
+    "insert_node",
+    "delete_node",
+    "randomize_tree",
+    "crossover_trees",
+    "gen_random_tree",
+    "gen_random_tree_fixed_size",
+]
+
+
+class MutationContext(NamedTuple):
+    """Static + traced context shared by mutation kernels."""
+
+    nops: Tuple[int, ...]  # static per-arity operator counts (1-based arity)
+    nfeatures: int         # static
+    max_nodes: int         # static (L)
+    perturbation_factor: float
+    probability_negate_constant: float
+
+
+def _slot_mask(tree: TreeBatch):
+    return jnp.arange(tree.arity.shape[0]) < tree.length
+
+
+def _structure(tree: TreeBatch):
+    return _tree_structure_single(tree.arity, tree.length)
+
+
+def _span(size, k):
+    """(start, length) of the subtree rooted at slot k."""
+    return k - size[k] + 1, size[k]
+
+
+# ---------------------------------------------------------------------------
+# Value mutations
+# ---------------------------------------------------------------------------
+
+
+def _mutate_factor(key, temperature, ctx: MutationContext, dtype):
+    """Constant perturbation factor (src/MutationFunctions.jl:150-162).
+
+    Note: the reference negates when ``rand() > probability_negate_constant``
+    (:158), i.e. ~99% of the time with default 0.00743 — contradicting both
+    the parameter's docstring and its name. We implement the documented
+    semantics (negate *with* probability `probability_negate_constant`).
+    """
+    k1, k2, k3 = jax.random.split(key, 3)
+    bottom = 0.1
+    max_change = ctx.perturbation_factor * temperature + 1.0 + bottom
+    factor = jnp.asarray(max_change, dtype) ** jax.random.uniform(k1, dtype=dtype)
+    bigger = jax.random.bernoulli(k2)
+    factor = jnp.where(bigger, factor, 1.0 / factor)
+    negate = jax.random.bernoulli(k3, ctx.probability_negate_constant)
+    return jnp.where(negate, -factor, factor)
+
+
+def mutate_constant(key, tree: TreeBatch, temperature, ctx: MutationContext):
+    k1, k2 = jax.random.split(key)
+    mask = _slot_mask(tree) & (tree.arity == 0) & (tree.op == LEAF_CONST)
+    idx, has_any = masked_choice(k1, mask)
+    factor = _mutate_factor(k2, temperature, ctx, tree.const.dtype)
+    new_const = tree.const.at[idx].multiply(factor)
+    const = jnp.where(has_any, new_const, tree.const)
+    return TreeBatch(tree.arity, tree.op, tree.feat, const, tree.length), jnp.bool_(True)
+
+
+def mutate_operator(key, tree: TreeBatch, ctx: MutationContext):
+    k1, k2 = jax.random.split(key)
+    mask = _slot_mask(tree) & (tree.arity > 0)
+    idx, has_any = masked_choice(k1, mask)
+    samples = [
+        randint_dyn(jax.random.fold_in(k2, d), max(n, 1))
+        for d, n in enumerate(ctx.nops, start=1)
+    ]
+    a = tree.arity[idx]
+    new_op = jnp.int32(0)
+    for d, s in enumerate(samples, start=1):
+        new_op = jnp.where(a == d, s, new_op)
+    op = jnp.where(has_any, tree.op.at[idx].set(new_op), tree.op)
+    return TreeBatch(tree.arity, op, tree.feat, tree.const, tree.length), jnp.bool_(True)
+
+
+def mutate_feature(key, tree: TreeBatch, ctx: MutationContext):
+    k1, k2 = jax.random.split(key)
+    mask = _slot_mask(tree) & (tree.arity == 0) & (tree.op == LEAF_VAR)
+    idx, has_any = masked_choice(k1, mask)
+    if ctx.nfeatures <= 1:
+        return tree, jnp.bool_(True)
+    # uniform among features != current (src/MutationFunctions.jl:181)
+    delta = randint_dyn(k2, ctx.nfeatures - 1) + 1
+    new_feat = (tree.feat[idx] + delta) % ctx.nfeatures
+    feat = jnp.where(has_any, tree.feat.at[idx].set(new_feat), tree.feat)
+    return TreeBatch(tree.arity, tree.op, feat, tree.const, tree.length), jnp.bool_(True)
+
+
+# ---------------------------------------------------------------------------
+# Structural mutations
+# ---------------------------------------------------------------------------
+
+
+def swap_operands(key, tree: TreeBatch, ctx: MutationContext):
+    """Swap the two child spans of a random binary node (:83-96)."""
+    L = ctx.max_nodes
+    child, size, _ = _structure(tree)
+    mask = _slot_mask(tree) & (tree.arity == 2)
+    k_node, has_any = masked_choice(key, mask)
+    c1 = child[k_node, 0]
+    c2 = child[k_node, 1]
+    s1, l1 = _span(size, c1)
+    s2, l2 = _span(size, c2)
+    sources = (tree.arity, tree.op, tree.feat, tree.const)
+    starts = jnp.stack([jnp.int32(0), s2, s1, k_node, k_node + 1])
+    lens = jnp.stack([s1, l2, l1, jnp.int32(1), tree.length - (k_node + 1)])
+    new_tree, ok = concat_pieces(sources, starts, lens, L)
+    return _select_tree(has_any, new_tree, tree), ok | ~has_any
+
+
+def delete_node(key, tree: TreeBatch, ctx: MutationContext):
+    """Splice out a random operator node, keeping one child (:336-356)."""
+    L = ctx.max_nodes
+    k1, k2 = jax.random.split(key)
+    child, size, _ = _structure(tree)
+    mask = _slot_mask(tree) & (tree.arity > 0)
+    k_node, has_any = masked_choice(k1, mask)
+    carry_i = randint_dyn(k2, jnp.maximum(tree.arity[k_node], 1))
+    carry = child[k_node, jnp.clip(carry_i, 0, MAX_ARITY - 1)]
+    node_start, node_len = _span(size, k_node)
+    carry_start, carry_len = _span(size, carry)
+    sources = (tree.arity, tree.op, tree.feat, tree.const)
+    new_tree, ok = splice_span(
+        tree, node_start, k_node, sources, carry_start, carry_len, L
+    )
+    return _select_tree(has_any, new_tree, tree), ok | ~has_any
+
+
+def _make_leaf_scratch(key, n_slots, ctx: MutationContext, dtype):
+    """Scratch arrays holding `n_slots` random leaves + one op slot.
+
+    Layout: slots [0..MAX_ARITY-1] are random leaves (50/50 constant ~
+    randn / variable ~ uniform feature, src/MutationFunctions.jl:321-333);
+    slot MAX_ARITY is reserved for a new operator node written by callers.
+    """
+    S = MAX_ARITY + 1
+    keys = jax.random.split(key, MAX_ARITY * 3)
+    arity = jnp.zeros((S,), jnp.int32)
+    op = jnp.zeros((S,), jnp.int32)
+    feat = jnp.zeros((S,), jnp.int32)
+    const = jnp.zeros((S,), dtype)
+    for j in range(MAX_ARITY):
+        is_const = jax.random.bernoulli(keys[3 * j])
+        val = jax.random.normal(keys[3 * j + 1], dtype=dtype)
+        f = randint_dyn(keys[3 * j + 2], ctx.nfeatures)
+        op = op.at[j].set(jnp.where(is_const, LEAF_CONST, LEAF_VAR))
+        feat = feat.at[j].set(jnp.where(is_const, 0, f))
+        const = const.at[j].set(jnp.where(is_const, val, 0.0))
+    return arity, op, feat, const
+
+
+def _sample_new_op(key, ctx: MutationContext, limit_arity=None):
+    """Sample (arity, op_index) proportional to per-arity op counts
+    (the csum draw at src/MutationFunctions.jl:209-221)."""
+    k1, k2 = jax.random.split(key)
+    D = len(ctx.nops)
+    weights = jnp.asarray(ctx.nops, jnp.float32)
+    if limit_arity is not None:
+        weights = jnp.where(jnp.arange(1, D + 1) <= limit_arity, weights, 0.0)
+    total = jnp.sum(weights)
+    logits = jnp.where(weights > 0, jnp.log(jnp.maximum(weights, 1e-30)), -jnp.inf)
+    a = jax.random.categorical(k1, logits).astype(jnp.int32) + 1
+    samples = [
+        randint_dyn(jax.random.fold_in(k2, d), max(n, 1))
+        for d, n in enumerate(ctx.nops, start=1)
+    ]
+    o = jnp.int32(0)
+    for d, s in enumerate(samples, start=1):
+        o = jnp.where(a == d, s, o)
+    return a, o, total > 0
+
+
+def _expand_leaf_pieces(tree, scratch, k_node, node_start, node_len, new_arity,
+                        carry_slot, ctx):
+    """Pieces for replacing span [node_start, node_start+node_len) with a new
+    operator node whose children are scratch leaves except `carry_slot`,
+    which carries the original span. carry_slot=-1 means no carry (the
+    original span is dropped — used by append where the target is a leaf).
+    """
+    L = ctx.max_nodes
+    sources = combine_sources(
+        tree,
+        TreeBatch(scratch[0], scratch[1], scratch[2], scratch[3],
+                  jnp.int32(MAX_ARITY + 1)),
+    )
+    starts = [jnp.int32(0)]
+    lens = [node_start]
+    for j in range(MAX_ARITY):
+        in_use = j < new_arity
+        is_carry = j == carry_slot
+        starts.append(jnp.where(is_carry, node_start, L + j))
+        lens.append(jnp.where(in_use, jnp.where(is_carry, node_len, 1), 0))
+    # the new operator node (scratch slot MAX_ARITY)
+    starts.append(jnp.int32(L + MAX_ARITY))
+    lens.append(jnp.int32(1))
+    # suffix
+    starts.append(node_start + node_len)
+    lens.append(tree.length - (node_start + node_len))
+    return concat_pieces(sources, jnp.stack(starts), jnp.stack(lens), L)
+
+
+def _write_op_slot(scratch, a, o):
+    arity, op, feat, const = scratch
+    arity = arity.at[MAX_ARITY].set(a)
+    op = op.at[MAX_ARITY].set(o)
+    return arity, op, feat, const
+
+
+def add_node(key, tree: TreeBatch, ctx: MutationContext):
+    """append/prepend a random op, 50/50 (src/Mutate.jl:479-497)."""
+    k0, k1 = jax.random.split(key)
+    do_append = jax.random.bernoulli(k0)
+    appended, ok_a = append_random_op(k1, tree, ctx)
+    prepended, ok_p = prepend_random_op(k1, tree, ctx)
+    out = _select_tree(do_append, appended, prepended)
+    return out, jnp.where(do_append, ok_a, ok_p)
+
+
+def append_random_op(key, tree: TreeBatch, ctx: MutationContext):
+    """Replace a random leaf with op(random leaves) (:199-226)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    child, size, _ = _structure(tree)
+    mask = _slot_mask(tree) & (tree.arity == 0)
+    k_leaf, has_any = masked_choice(k1, mask)
+    a, o, any_op = _sample_new_op(k2, ctx)
+    scratch = _make_leaf_scratch(k3, MAX_ARITY, ctx, tree.const.dtype)
+    scratch = _write_op_slot(scratch, a, o)
+    new_tree, ok = _expand_leaf_pieces(
+        tree, scratch, k_leaf, k_leaf, jnp.int32(1), a, jnp.int32(-1), ctx
+    )
+    valid = has_any & any_op
+    return _select_tree(valid, new_tree, tree), ok | ~valid
+
+
+def insert_random_op(key, tree: TreeBatch, ctx: MutationContext):
+    """Wrap a random node inside a new op (:243-272)."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    child, size, _ = _structure(tree)
+    mask = _slot_mask(tree)
+    k_node, has_any = masked_choice(k1, mask)
+    a, o, any_op = _sample_new_op(k2, ctx)
+    carry = randint_dyn(k4, jnp.maximum(a, 1))
+    scratch = _make_leaf_scratch(k3, MAX_ARITY, ctx, tree.const.dtype)
+    scratch = _write_op_slot(scratch, a, o)
+    node_start, node_len = _span(size, k_node)
+    new_tree, ok = _expand_leaf_pieces(
+        tree, scratch, k_node, node_start, node_len, a, carry, ctx
+    )
+    valid = has_any & any_op
+    return _select_tree(valid, new_tree, tree), ok | ~valid
+
+
+def prepend_random_op(key, tree: TreeBatch, ctx: MutationContext):
+    """New root with the old tree as a random child (:289-319)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    a, o, any_op = _sample_new_op(k1, ctx)
+    carry = randint_dyn(k2, jnp.maximum(a, 1))
+    scratch = _make_leaf_scratch(k3, MAX_ARITY, ctx, tree.const.dtype)
+    scratch = _write_op_slot(scratch, a, o)
+    new_tree, ok = _expand_leaf_pieces(
+        tree, scratch, tree.length - 1, jnp.int32(0), tree.length, a, carry, ctx
+    )
+    return _select_tree(any_op, new_tree, tree), ok | ~any_op
+
+
+def rotate_tree(key, tree: TreeBatch, ctx: MutationContext):
+    """AVL-style random rotation (randomly_rotate_tree!, :594-633).
+
+    Chooses a rotation root R (an operator node with at least one operator
+    child), a pivot P (operator child of R) and grandchild G (child of P);
+    the rotated subtree is P with G's slot replaced by R' = R with P's slot
+    replaced by G. Node count is preserved, so this is a permutation of
+    spans — implemented as a 9-piece gather.
+    """
+    L = ctx.max_nodes
+    k1, k2, k3 = jax.random.split(key, 3)
+    child, size, _ = _structure(tree)
+    slot_ok = _slot_mask(tree)
+    child_arity = tree.arity[jnp.clip(child, 0, L - 1)]  # [L, A]
+    has_op_child = jnp.any(
+        (child_arity > 0) & (jnp.arange(MAX_ARITY) < tree.arity[:, None]), axis=1
+    )
+    root_mask = slot_ok & (tree.arity > 0) & has_op_child
+    r, has_root = masked_choice(k1, root_mask)
+
+    pivot_mask = (jnp.arange(MAX_ARITY) < tree.arity[r]) & (child_arity[r] > 0)
+    pi, _ = masked_choice(k2, pivot_mask)
+    p = child[r, pi]
+    gi = randint_dyn(k3, jnp.maximum(tree.arity[p], 1))
+    g = child[p, jnp.clip(gi, 0, MAX_ARITY - 1)]
+
+    def span_of(x):
+        return x - size[x] + 1, size[x]
+
+    g_start, g_len = span_of(g)
+    # R' pieces: R's children in order with pivot slot -> G span; then R.
+    rp_starts, rp_lens = [], []
+    for i in range(MAX_ARITY):
+        in_use = i < tree.arity[r]
+        ci = child[r, i]
+        ci_start, ci_len = span_of(ci)
+        s = jnp.where(i == pi, g_start, ci_start)
+        ln = jnp.where(i == pi, g_len, ci_len)
+        rp_starts.append(jnp.where(in_use, s, 0))
+        rp_lens.append(jnp.where(in_use, ln, 0))
+    rp_starts.append(r)
+    rp_lens.append(jnp.int32(1))
+
+    # P' pieces: P's children in order, with G's slot -> the 3 R' pieces.
+    starts, lens = [], []
+    span_start, span_len = span_of(r)
+    starts.append(jnp.int32(0))
+    lens.append(span_start)
+    for j in range(MAX_ARITY):
+        in_use = j < tree.arity[p]
+        cj = child[p, j]
+        cj_start, cj_len = span_of(cj)
+        is_g = j == gi
+        # three sub-pieces: either the R' triple, or (child span, 0, 0)
+        starts.append(jnp.where(is_g, rp_starts[0], cj_start))
+        lens.append(jnp.where(in_use, jnp.where(is_g, rp_lens[0], cj_len), 0))
+        starts.append(jnp.where(is_g, rp_starts[1], 0))
+        lens.append(jnp.where(in_use & is_g, rp_lens[1], 0))
+        starts.append(jnp.where(is_g, rp_starts[2], 0))
+        lens.append(jnp.where(in_use & is_g, rp_lens[2], 0))
+    starts.append(p)
+    lens.append(jnp.int32(1))
+    starts.append(span_start + span_len)
+    lens.append(tree.length - (span_start + span_len))
+
+    sources = (tree.arity, tree.op, tree.feat, tree.const)
+    new_tree, ok = concat_pieces(sources, jnp.stack(starts), jnp.stack(lens), L)
+    return _select_tree(has_root, new_tree, tree), ok | ~has_root
+
+
+def crossover_trees(key, tree1: TreeBatch, tree2: TreeBatch, ctx: MutationContext):
+    """Random subtree exchange (crossover_trees, :488-518)."""
+    L = ctx.max_nodes
+    k1, k2 = jax.random.split(key)
+    _, size1, _ = _structure(tree1)
+    _, size2, _ = _structure(tree2)
+    n1, _ = masked_choice(k1, _slot_mask(tree1))
+    n2, _ = masked_choice(k2, _slot_mask(tree2))
+    s1, l1 = _span(size1, n1)
+    s2, l2 = _span(size2, n2)
+    sources12 = combine_sources(tree1, tree2)
+    child1, ok1 = splice_span(tree1, s1, n1, sources12, L + s2, l2, L)
+    sources21 = combine_sources(tree2, tree1)
+    child2, ok2 = splice_span(tree2, s2, n2, sources21, L + s1, l1, L)
+    return child1, child2, ok1, ok2
+
+
+# ---------------------------------------------------------------------------
+# Random tree generation
+# ---------------------------------------------------------------------------
+
+
+def _make_single_leaf(key, ctx: MutationContext, dtype):
+    k1, k2, k3 = jax.random.split(key, 3)
+    is_const = jax.random.bernoulli(k1)
+    L = ctx.max_nodes
+    t = TreeBatch(
+        arity=jnp.zeros((L,), jnp.int32),
+        op=jnp.zeros((L,), jnp.int32).at[0].set(
+            jnp.where(is_const, LEAF_CONST, LEAF_VAR)
+        ),
+        feat=jnp.zeros((L,), jnp.int32).at[0].set(
+            jnp.where(is_const, 0, randint_dyn(k2, ctx.nfeatures))
+        ),
+        const=jnp.zeros((L,), dtype).at[0].set(
+            jnp.where(is_const, jax.random.normal(k3, dtype=dtype), 0.0)
+        ),
+        length=jnp.int32(1),
+    )
+    return t
+
+
+def gen_random_tree_fixed_size(key, node_count, ctx: MutationContext, dtype,
+                               n_steps=None):
+    """Leaf-growth random tree of ~`node_count` nodes
+    (gen_random_tree_fixed_size, src/MutationFunctions.jl:441-471)."""
+    L = ctx.max_nodes
+    n_steps = n_steps if n_steps is not None else L
+    k0, kloop = jax.random.split(key)
+    tree0 = _make_single_leaf(k0, ctx, dtype)
+
+    def body(i, tree):
+        k = jax.random.fold_in(kloop, i)
+        k1, k2, k3 = jax.random.split(k, 3)
+        remaining = node_count - tree.length
+        limit = jnp.minimum(remaining, MAX_ARITY)
+        a, o, any_op = _sample_new_op(k1, ctx, limit_arity=limit)
+        mask = _slot_mask(tree) & (tree.arity == 0)
+        k_leaf, has_leaf = masked_choice(k2, mask)
+        scratch = _make_leaf_scratch(k3, MAX_ARITY, ctx, dtype)
+        scratch = _write_op_slot(scratch, a, o)
+        new_tree, ok = _expand_leaf_pieces(
+            tree, scratch, k_leaf, k_leaf, jnp.int32(1), a, jnp.int32(-1), ctx
+        )
+        do = (remaining > 0) & any_op & has_leaf & ok
+        return _select_tree(do, new_tree, tree)
+
+    return jax.lax.fori_loop(0, n_steps, body, tree0)
+
+
+def gen_random_tree(key, nlength, ctx: MutationContext, dtype):
+    """Append `nlength` random ops at random leaves (gen_random_tree,
+    :384-398). Initial placeholder leaf is replaced by the first append."""
+    k0, kloop = jax.random.split(key)
+    tree0 = _make_single_leaf(k0, ctx, dtype)
+
+    def body(i, tree):
+        k = jax.random.fold_in(kloop, i)
+        new_tree, ok = append_random_op(k, tree, ctx)
+        return _select_tree(ok, new_tree, tree)
+
+    return jax.lax.fori_loop(0, nlength, body, tree0)
+
+
+def randomize_tree(key, tree: TreeBatch, cur_maxsize, ctx: MutationContext):
+    """Replace with a fresh random tree of size ~U(1, curmaxsize)
+    (randomize_tree, :372-381)."""
+    k1, k2 = jax.random.split(key)
+    target = randint_dyn(k1, jnp.maximum(cur_maxsize, 1)) + 1
+    new_tree = gen_random_tree_fixed_size(k2, target, ctx, tree.const.dtype)
+    return new_tree, jnp.bool_(True)
+
+
+def _select_tree(pred, a: TreeBatch, b: TreeBatch) -> TreeBatch:
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)

@@ -1,0 +1,104 @@
+"""Piece-concatenation gathers: structural tree edits as index arithmetic.
+
+In postfix order every subtree is a contiguous slot range, so every
+structural mutation of the reference (insert/delete/append/prepend/rotate/
+crossover, /root/reference/src/MutationFunctions.jl) can be expressed as
+"concatenate these source spans in this order" — one gather per field, no
+pointer surgery, fully vmappable and jit-compatible with static shapes.
+
+The generic helper takes up to NP pieces, each ``(start, len)`` into a
+combined source array (possibly the concatenation of several trees plus a
+scratch buffer of newly created nodes), and produces the output tree.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.encoding import TreeBatch
+
+__all__ = ["concat_pieces", "combine_sources", "tree_fields", "make_tree"]
+
+
+def tree_fields(t: TreeBatch):
+    return (t.arity, t.op, t.feat, t.const)
+
+
+def make_tree(arity, op, feat, const, length) -> TreeBatch:
+    return TreeBatch(arity=arity, op=op, feat=feat, const=const, length=length)
+
+
+def combine_sources(*trees: TreeBatch):
+    """Concatenate several unbatched trees' field arrays along the slot axis.
+
+    Piece starts for tree ``i`` are offset by ``i * L``.
+    """
+    arity = jnp.concatenate([t.arity for t in trees])
+    op = jnp.concatenate([t.op for t in trees])
+    feat = jnp.concatenate([t.feat for t in trees])
+    const = jnp.concatenate([t.const for t in trees])
+    return arity, op, feat, const
+
+
+def concat_pieces(
+    sources,  # (arity, op, feat, const) combined source arrays, each [S]
+    starts: jax.Array,  # [NP] int32 — start of each piece in source coords
+    lens: jax.Array,    # [NP] int32 — piece lengths (0 = skip)
+    max_nodes: int,
+) -> Tuple[TreeBatch, jax.Array]:
+    """Build a tree from ordered source pieces.
+
+    Returns ``(tree, ok)`` where ``ok`` is False when the total length
+    exceeds ``max_nodes`` (caller must treat the output as garbage and
+    reject the attempt, mirroring the reference's retry-on-constraint
+    loop).
+    """
+    s_arity, s_op, s_feat, s_const = sources
+    NP = starts.shape[0]
+    offs = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens).astype(jnp.int32)]
+    )
+    total = offs[-1]
+    ok = total <= max_nodes
+    k = jnp.arange(max_nodes, dtype=jnp.int32)
+    # piece_id[k]: the piece covering output slot k.
+    piece_id = jnp.searchsorted(offs[1:], k, side="right").astype(jnp.int32)
+    piece_id = jnp.clip(piece_id, 0, NP - 1)
+    src = starts[piece_id] + (k - offs[piece_id])
+    src = jnp.clip(src, 0, s_arity.shape[0] - 1)
+    mask = k < total
+    tree = TreeBatch(
+        arity=jnp.where(mask, s_arity[src], 0),
+        op=jnp.where(mask, s_op[src], 0),
+        feat=jnp.where(mask, s_feat[src], 0),
+        const=jnp.where(mask, s_const[src], 0.0),
+        length=jnp.minimum(total, max_nodes).astype(jnp.int32),
+    )
+    return tree, ok
+
+
+def splice_span(
+    tree: TreeBatch,
+    span_start: jax.Array,
+    span_end: jax.Array,  # inclusive
+    replacement_sources,
+    repl_start: jax.Array,
+    repl_len: jax.Array,
+    max_nodes: int,
+) -> Tuple[TreeBatch, jax.Array]:
+    """Replace ``tree[span_start..span_end]`` with a span from another source.
+
+    ``replacement_sources`` are combined source arrays that must already
+    contain ``tree``'s own arrays first (offset 0) so prefix/suffix pieces
+    resolve; ``repl_start`` is in combined coordinates.
+    """
+    starts = jnp.stack(
+        [jnp.int32(0), repl_start, span_end + 1]
+    )
+    lens = jnp.stack(
+        [span_start, repl_len, tree.length - (span_end + 1)]
+    )
+    return concat_pieces(replacement_sources, starts, lens, max_nodes)

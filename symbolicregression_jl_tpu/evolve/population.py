@@ -1,0 +1,73 @@
+"""Population state (device-resident) and random initialization.
+
+The reference's `Population` (vector of PopMember,
+/root/reference/src/Population.jl:15-18) becomes a struct-of-arrays with a
+member axis; `PopMember` fields (tree, cost, loss, birth, complexity,
+ref/parent lineage ids, src/PopMember.jl:11-21) are parallel arrays.
+Leading axes stack islands (and outputs) for single-launch evolution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.encoding import TreeBatch
+from .mutation import MutationContext, gen_random_tree
+
+__all__ = ["PopulationState", "init_population"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PopulationState:
+    trees: TreeBatch        # fields [..., P, L]
+    cost: jax.Array         # [..., P]
+    loss: jax.Array         # [..., P]
+    complexity: jax.Array   # [..., P] int32
+    birth: jax.Array        # [..., P] int32 (deterministic birth-order ticks,
+                            # src/Utils.jl:14-24)
+    ref: jax.Array          # [..., P] int32 lineage id
+    parent: jax.Array       # [..., P] int32 parent lineage id
+
+    @property
+    def pop_size(self) -> int:
+        return self.cost.shape[-1]
+
+    def member(self, idx) -> "PopulationState":
+        """Gather a single member (or indexed subset) along the member axis."""
+        take = lambda x: jnp.take(x, idx, axis=-1)
+        take_tree = lambda x: jnp.take(x, idx, axis=-2)
+        return PopulationState(
+            trees=TreeBatch(
+                arity=take_tree(self.trees.arity),
+                op=take_tree(self.trees.op),
+                feat=take_tree(self.trees.feat),
+                const=take_tree(self.trees.const),
+                length=take(self.trees.length),
+            ),
+            cost=take(self.cost),
+            loss=take(self.loss),
+            complexity=take(self.complexity),
+            birth=take(self.birth),
+            ref=take(self.ref),
+            parent=take(self.parent),
+        )
+
+
+def init_population(
+    key: jax.Array,
+    population_size: int,
+    ctx: MutationContext,
+    dtype,
+    nlength: int = 3,
+) -> TreeBatch:
+    """Random trees via `gen_random_tree(nlength=3)` (src/Population.jl:35-61).
+
+    Returns only the trees; costs are filled by the caller's eval pass.
+    """
+    keys = jax.random.split(key, population_size)
+    return jax.vmap(lambda k: gen_random_tree(k, nlength, ctx, dtype))(keys)

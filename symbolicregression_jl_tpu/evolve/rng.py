@@ -1,0 +1,40 @@
+"""RNG helpers for device-side evolution.
+
+Threaded `jax.random` keys replace the reference's global RNG; keys are
+split per (island, cycle, slot, purpose) so runs are reproducible with a
+seed (deterministic-mode semantics of src/Utils.jl:14-24 fall out for
+free: device evolution is always deterministic given the key).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["randint_dyn", "masked_choice", "categorical_from_weights"]
+
+
+def randint_dyn(key, n, shape=()):
+    """Uniform integer in [0, n) with a *traced* upper bound (n >= 1)."""
+    u = jax.random.uniform(key, shape)
+    return jnp.minimum((u * n).astype(jnp.int32), jnp.asarray(n - 1, jnp.int32))
+
+
+def masked_choice(key, mask):
+    """Uniform choice among True entries of ``mask`` (1-D).
+
+    Returns (index, has_any). When no entry is True, index is 0 and
+    has_any False — callers must treat the pick as a failed attempt.
+    """
+    logits = jnp.where(mask, 0.0, -jnp.inf)
+    has_any = jnp.any(mask)
+    idx = jnp.where(
+        has_any, jax.random.categorical(key, logits), jnp.int32(0)
+    ).astype(jnp.int32)
+    return idx, has_any
+
+
+def categorical_from_weights(key, weights):
+    """Sample an index proportional to non-negative ``weights`` (1-D)."""
+    logits = jnp.where(weights > 0, jnp.log(jnp.maximum(weights, 1e-30)), -jnp.inf)
+    return jax.random.categorical(key, logits).astype(jnp.int32)

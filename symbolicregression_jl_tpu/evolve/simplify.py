@@ -1,0 +1,130 @@
+"""Device-side constant folding (simplify_tree! analogue).
+
+Collapses maximal all-constant subtrees into single constant leaves using
+one interpreter pass on a single dummy row plus a compaction gather — the
+tensor equivalent of DynamicExpressions' `simplify_tree!` as invoked once
+per iteration in optimize_and_simplify_population
+(/root/reference/src/SingleIteration.jl:79-85). The algebraic
+`combine_operators` rewrites remain host-side (ops.tree.combine_operators)
+and run outside the hot path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.encoding import (
+    LEAF_CONST,
+    MAX_ARITY,
+    TreeBatch,
+    _tree_structure_single,
+)
+from ..ops.eval import eval_single_tree
+
+__all__ = ["fold_constants_batch"]
+
+
+def _fold_single(tree: TreeBatch, X1, operators):
+    """Fold one tree. X1 is a [F, 1] dummy input."""
+    L = tree.arity.shape[0]
+    child, size, _ = _tree_structure_single(tree.arity, tree.length)
+    slot = jnp.arange(L)
+    in_tree = slot < tree.length
+
+    # is_const_subtree via one postfix stack scan.
+    def step(carry, k):
+        stack, sp = carry
+        a = tree.arity[k]
+        all_const = jnp.bool_(True)
+        for j in range(MAX_ARITY):
+            pos = sp - a + j
+            is_child = j < a
+            all_const = all_const & (
+                ~is_child | stack[jnp.maximum(pos, 0)]
+            )
+        leaf_const = tree.op[k] == LEAF_CONST
+        c_k = jnp.where(a == 0, leaf_const, all_const)
+        new_sp = sp - a + 1
+        stack = stack.at[new_sp - 1].set(c_k)
+        return (stack, new_sp), c_k
+
+    (_, _), is_const = jax.lax.scan(
+        step, (jnp.zeros((L,), jnp.bool_), jnp.int32(0)),
+        jnp.arange(L, dtype=jnp.int32),
+    )
+
+    # Node values on the dummy row: const-subtree values are X-independent.
+    # We need the full buffer, so inline a tiny interpreter via the spans:
+    # reuse eval by evaluating each prefix? Cheaper: evaluate once and read
+    # the buffer — replicate eval_single_tree's scan but keep buf.
+    from ..ops.eval import _apply_tables
+    from ..ops.encoding import LEAF_PARAM
+
+    def eval_step(carry, k):
+        buf, = carry
+        a = tree.arity[k]
+        o = tree.op[k]
+        children = [
+            jax.lax.dynamic_index_in_dim(buf, child[k, j], axis=0, keepdims=False)
+            for j in range(MAX_ARITY)
+        ]
+        x_row = jax.lax.dynamic_index_in_dim(X1, tree.feat[k], axis=0, keepdims=False)
+        leaf = jnp.where(o == LEAF_CONST, jnp.broadcast_to(tree.const[k], (1,)), x_row)
+        leaf = jnp.where((a == 0) & (o == LEAF_PARAM), jnp.nan, leaf)
+        val = _apply_tables(operators, a, o, leaf, children).astype(tree.const.dtype)
+        buf = buf.at[k].set(val)
+        return (buf,), None
+
+    (buf,), _ = jax.lax.scan(
+        eval_step, (jnp.zeros((L, 1), tree.const.dtype),),
+        jnp.arange(L, dtype=jnp.int32),
+    )
+    values = buf[:, 0]
+
+    # parent const-ness: a node is *inside* a folded subtree if any ancestor
+    # is const. Equivalent: node k is kept iff it is not a strict descendant
+    # of a const-subtree root. Using spans: k is a descendant of m iff
+    # m - size[m] < k < m. Compute "covered" via a reverse sweep: mark const
+    # roots (const node whose parent is not const); then a node is dropped
+    # iff it lies strictly inside some const root's span.
+    parent_const = jnp.zeros((L,), jnp.bool_)
+    # parent pointer: parent[c] = k for each child c of k
+    parent = jnp.full((L,), -1, jnp.int32)
+    for j in range(MAX_ARITY):
+        is_child = (jnp.arange(MAX_ARITY)[j] < tree.arity) & in_tree
+        parent = parent.at[jnp.where(is_child, child[:, j], L)].set(
+            slot, mode="drop"
+        )
+    has_parent = parent >= 0
+    parent_is_const = jnp.where(
+        has_parent, is_const[jnp.clip(parent, 0, L - 1)], False
+    )
+    is_fold_root = is_const & ~parent_is_const & in_tree
+    keep = in_tree & (~is_const | is_fold_root)
+
+    # Compact: gather kept slots in order.
+    new_len = jnp.sum(keep.astype(jnp.int32))
+    order_key = jnp.where(keep, slot, L + slot)  # kept first, stable
+    perm = jnp.argsort(order_key)
+    g = lambda x: x[perm]
+    folded_to_leaf = is_fold_root & (tree.arity > 0)
+    arity = jnp.where(folded_to_leaf, 0, tree.arity)
+    op = jnp.where(folded_to_leaf, LEAF_CONST, tree.op)
+    const = jnp.where(is_fold_root, values, tree.const)
+    out_mask = slot < new_len
+    return TreeBatch(
+        arity=jnp.where(out_mask, g(arity), 0),
+        op=jnp.where(out_mask, g(op), 0),
+        feat=jnp.where(out_mask, g(tree.feat), 0),
+        const=jnp.where(out_mask, g(const), 0.0),
+        length=new_len,
+    )
+
+
+def fold_constants_batch(trees: TreeBatch, nfeatures: int, operators) -> TreeBatch:
+    """Fold constants for a [P, L] batch of trees."""
+    X1 = jnp.zeros((nfeatures, 1), trees.const.dtype)
+    return jax.vmap(lambda a, o, f, c, ln: _fold_single(
+        TreeBatch(a, o, f, c, ln), X1, operators
+    ))(trees.arity, trees.op, trees.feat, trees.const, trees.length)

@@ -1,0 +1,528 @@
+"""The jitted evolution step: tournaments, mutations, accepts, replacement.
+
+This collapses the reference's sequential `reg_evol_cycle`
+(/root/reference/src/RegularizedEvolution.jl:13-158) into a bulk device
+step: the ``ceil(P / tournament_n)`` steps of one cycle all run in
+parallel from the same population snapshot (SURVEY.md §7 design delta 2),
+each producing up to two babies (mutation, or crossover's pair) that
+replace the oldest members. The reference's retry-until-valid loop
+(≤10 attempts, src/Mutate.jl:209-245) becomes a speculative batch over an
+attempt axis with first-valid selection.
+
+`s_r_cycle` then scans `ncycles` of these steps over the annealing
+temperature ramp (src/SingleIteration.jl:19-66), maintaining the
+best-seen-per-complexity mini hall of fame on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.losses import aggregate_loss, loss_to_cost
+from ..core.options import MUTATION_KINDS, Options
+from ..ops.complexity import (
+    ComplexityTables,
+    check_constraints_batch,
+    compute_complexity_batch,
+)
+from ..ops.encoding import LEAF_CONST, TreeBatch, tree_structure_arrays
+from ..ops.eval import eval_tree_batch
+from ..ops.operators import OperatorSet
+from . import mutation as M
+from .population import PopulationState
+from .rng import categorical_from_weights
+from .tournament import tournament_select
+
+__all__ = ["EvolveConfig", "HofState", "generation_step", "s_r_cycle", "empty_hof",
+           "update_hof", "eval_cost_batch"]
+
+_KIND = {name: i for i, name in enumerate(MUTATION_KINDS)}
+_IMMEDIATE_KINDS = (_KIND["simplify"], _KIND["do_nothing"], _KIND["optimize"],
+                    _KIND["form_connection"], _KIND["break_connection"])
+
+
+class EvolveConfig(NamedTuple):
+    """Static engine configuration derived from Options (hashable)."""
+
+    operators: OperatorSet
+    maxsize: int
+    maxdepth: int
+    max_nodes: int           # slot budget L (== maxsize)
+    population_size: int
+    tournament_n: int
+    tournament_p: float
+    crossover_probability: float
+    annealing: bool
+    alpha: float
+    use_frequency: bool
+    use_frequency_in_tournament: bool
+    adaptive_parsimony_scaling: float
+    parsimony: float
+    skip_mutation_failures: bool
+    should_simplify: bool
+    attempts: int
+    nfeatures: int
+    perturbation_factor: float
+    probability_negate_constant: float
+    ncycles: int
+    batching: bool
+    batch_size: int
+
+    @property
+    def n_slots(self) -> int:
+        # n_evol_cycles = ceil(P / tournament_n), src/RegularizedEvolution.jl:23
+        return -(-self.population_size // self.tournament_n)
+
+    @property
+    def mctx(self) -> M.MutationContext:
+        return M.MutationContext(
+            nops=self.operators.nops_tuple(),
+            nfeatures=self.nfeatures,
+            max_nodes=self.max_nodes,
+            perturbation_factor=self.perturbation_factor,
+            probability_negate_constant=self.probability_negate_constant,
+        )
+
+
+def evolve_config_from_options(options: Options, nfeatures: int) -> EvolveConfig:
+    return EvolveConfig(
+        operators=options.operators,
+        maxsize=options.maxsize,
+        maxdepth=options.maxdepth,
+        max_nodes=options.maxsize,
+        population_size=options.population_size,
+        tournament_n=options.tournament_selection_n,
+        tournament_p=options.tournament_selection_p,
+        crossover_probability=options.crossover_probability,
+        annealing=options.annealing,
+        alpha=options.alpha,
+        use_frequency=options.use_frequency,
+        use_frequency_in_tournament=options.use_frequency_in_tournament,
+        adaptive_parsimony_scaling=options.adaptive_parsimony_scaling,
+        parsimony=options.parsimony,
+        skip_mutation_failures=options.skip_mutation_failures,
+        should_simplify=options.should_simplify,
+        attempts=options.mutation_attempts,
+        nfeatures=nfeatures,
+        perturbation_factor=options.perturbation_factor,
+        probability_negate_constant=options.probability_negate_constant,
+        ncycles=options.ncycles_per_iteration,
+        batching=options.batching,
+        batch_size=options.batch_size,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mutation weight conditioning (condition_mutation_weights!,
+# src/Mutate.jl:101-170)
+# ---------------------------------------------------------------------------
+
+
+def _condition_weights(base_w, tree: TreeBatch, complexity, cur_maxsize,
+                       cfg: EvolveConfig):
+    L = cfg.max_nodes
+    slot = jnp.arange(L)
+    mask = slot < tree.length
+    root = tree.length - 1
+    root_arity = tree.arity[root]
+    root_is_leaf = root_arity == 0
+    root_is_const = root_is_leaf & (tree.op[root] == LEAF_CONST)
+    has_binary = jnp.any(mask & (tree.arity == 2))
+    n_const = jnp.sum(mask & (tree.arity == 0) & (tree.op == LEAF_CONST))
+
+    w = base_w
+    zero = jnp.zeros((), base_w.dtype)
+
+    def setw(w, name, val):
+        return w.at[_KIND[name]].set(val)
+
+    # Leaf-only equations can't lose or reshuffle operators:
+    for name in ("mutate_operator", "swap_operands", "delete_node", "simplify"):
+        w = setw(w, name, jnp.where(root_is_leaf, zero, w[_KIND[name]]))
+    w = setw(w, "optimize",
+             jnp.where(root_is_leaf & ~root_is_const, zero, w[_KIND["optimize"]]))
+    w = setw(w, "mutate_constant",
+             jnp.where(root_is_leaf & ~root_is_const, zero, w[_KIND["mutate_constant"]]))
+    w = setw(w, "mutate_feature",
+             jnp.where(root_is_leaf & root_is_const, zero, w[_KIND["mutate_feature"]]))
+    w = setw(w, "swap_operands",
+             jnp.where(~has_binary, zero, w[_KIND["swap_operands"]]))
+    # constant-count scaling (condition_mutate_constant!, :159-170)
+    w = setw(w, "mutate_constant",
+             w[_KIND["mutate_constant"]] * jnp.minimum(8, n_const) / 8.0)
+    if cfg.nfeatures <= 1:
+        w = setw(w, "mutate_feature", zero)
+    too_big = complexity >= cur_maxsize
+    w = setw(w, "add_node", jnp.where(too_big, zero, w[_KIND["add_node"]]))
+    w = setw(w, "insert_node", jnp.where(too_big, zero, w[_KIND["insert_node"]]))
+    if not cfg.should_simplify:
+        w = setw(w, "simplify", zero)
+    # GraphNode-only mutations are always off for tree expressions:
+    w = setw(w, "form_connection", zero)
+    w = setw(w, "break_connection", zero)
+    return w
+
+
+# ---------------------------------------------------------------------------
+# Applying a sampled mutation kind (speculative attempts)
+# ---------------------------------------------------------------------------
+
+
+def _apply_kind(kind, key, tree: TreeBatch, temperature, cur_maxsize,
+                cfg: EvolveConfig):
+    """Apply mutation `kind` to `tree`; returns (tree, structural_ok)."""
+    mctx = cfg.mctx
+    branches = []
+
+    def add(name, fn):
+        branches.append((_KIND[name], fn))
+
+    add("mutate_constant", lambda k: M.mutate_constant(k, tree, temperature, mctx))
+    add("mutate_operator", lambda k: M.mutate_operator(k, tree, mctx))
+    add("mutate_feature", lambda k: M.mutate_feature(k, tree, mctx))
+    add("swap_operands", lambda k: M.swap_operands(k, tree, mctx))
+    add("rotate_tree", lambda k: M.rotate_tree(k, tree, mctx))
+    add("add_node", lambda k: M.add_node(k, tree, mctx))
+    add("insert_node", lambda k: M.insert_random_op(k, tree, mctx))
+    add("delete_node", lambda k: M.delete_node(k, tree, mctx))
+    add("randomize", lambda k: M.randomize_tree(k, tree, cur_maxsize, mctx))
+
+    out_tree = tree
+    out_ok = jnp.bool_(True)
+    for kid, fn in branches:
+        t, ok = fn(jax.random.fold_in(key, kid))
+        hit = kind == kid
+        out_tree = M._select_tree(hit, t, out_tree)
+        out_ok = jnp.where(hit, ok, out_ok)
+    return out_tree, out_ok
+
+
+def _first_valid(valid, stacked: TreeBatch, fallback: TreeBatch):
+    """Select the first attempt with valid=True, else fallback."""
+    any_valid = jnp.any(valid)
+    first = jnp.argmax(valid)
+    picked = jax.tree.map(lambda x: x[first], stacked)
+    return M._select_tree(any_valid, picked, fallback), any_valid
+
+
+def _check_single(tree: TreeBatch, options, tables, cur_maxsize):
+    batched = jax.tree.map(lambda x: x[None], tree)
+    child, size, depth = tree_structure_arrays(batched)
+    ok = check_constraints_batch(batched, options, tables, cur_maxsize,
+                                 child, size, depth)
+    return ok[0]
+
+
+# ---------------------------------------------------------------------------
+# Cost evaluation
+# ---------------------------------------------------------------------------
+
+
+def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
+                    operators, parsimony, batch_idx=None, params=None):
+    """Batched eval_cost (src/LossFunctions.jl:193-209): (cost, loss, complexity)."""
+    if batch_idx is None:
+        X = data.Xt
+        y = data.y
+        w = data.weights
+    else:
+        X = jnp.take(data.Xt, batch_idx, axis=1)
+        y = jnp.take(data.y, batch_idx)
+        w = None if data.weights is None else jnp.take(data.weights, batch_idx)
+    pred, valid = eval_tree_batch(trees, X, operators, params=params)
+    loss = aggregate_loss(elementwise_loss, pred, y, valid, w)
+    complexity = compute_complexity_batch(trees, tables)
+    cost = loss_to_cost(loss, data.baseline_loss, data.use_baseline, complexity,
+                        parsimony)
+    return cost, loss, complexity
+
+
+# ---------------------------------------------------------------------------
+# One bulk generation step (== one reg_evol_cycle)
+# ---------------------------------------------------------------------------
+
+
+def generation_step(
+    key,
+    pop: PopulationState,
+    data,
+    stats_nf,        # [maxsize] normalized frequencies (frozen per iteration)
+    temperature,
+    cur_maxsize,
+    birth0,          # scalar int32 birth counter
+    ref0,            # scalar int32 lineage counter
+    cfg: EvolveConfig,
+    options: Options,
+    tables: ComplexityTables,
+    elementwise_loss,
+    batch_idx=None,
+) -> Tuple[PopulationState, jax.Array, jax.Array, jax.Array]:
+    """Returns (new_pop, num_evals, new_birth0, new_ref0)."""
+    B = cfg.n_slots
+    A = cfg.attempts
+    P = cfg.population_size
+    keys = jax.random.split(key, B)
+
+    def tourney(k):
+        return tournament_select(
+            k, pop.cost, pop.complexity, stats_nf,
+            tournament_n=cfg.tournament_n, p=cfg.tournament_p,
+            use_frequency=cfg.use_frequency_in_tournament,
+            adaptive_parsimony_scaling=cfg.adaptive_parsimony_scaling,
+            maxsize=cfg.maxsize,
+        )
+
+    def slot_fn(k):
+        ks = jax.random.split(k, 8)
+        is_xover = jax.random.bernoulli(ks[0], cfg.crossover_probability)
+        i1 = tourney(ks[1])
+        i2 = tourney(ks[2])
+        m1 = pop.member(i1)
+        m2 = pop.member(i2)
+
+        # ---- mutation path ----
+        w = _condition_weights(
+            jnp.asarray(options.mutation_weights.as_vector(), jnp.float32),
+            m1.trees, m1.complexity, cur_maxsize, cfg,
+        )
+        kind = categorical_from_weights(ks[3], w)
+        immediate = jnp.zeros((), jnp.bool_)
+        for kid in _IMMEDIATE_KINDS:
+            immediate = immediate | (kind == kid)
+
+        att_keys = jax.random.split(ks[4], A)
+        att_trees, att_ok = jax.vmap(
+            lambda ak: _apply_kind(kind, ak, m1.trees, temperature, cur_maxsize, cfg)
+        )(att_keys)
+        child, size, depth = tree_structure_arrays(att_trees)
+        att_cons = check_constraints_batch(
+            att_trees, options, tables, cur_maxsize, child, size, depth
+        )
+        att_valid = att_ok & att_cons
+        mut_tree, mut_success = _first_valid(att_valid, att_trees, m1.trees)
+
+        # ---- crossover path ----
+        xa_keys = jax.random.split(ks[5], A)
+        c1s, c2s, ok1s, ok2s = jax.vmap(
+            lambda ak: M.crossover_trees(ak, m1.trees, m2.trees, cfg.mctx)
+        )(xa_keys)
+        ch1, sz1, dp1 = tree_structure_arrays(c1s)
+        cons1 = check_constraints_batch(c1s, options, tables, cur_maxsize, ch1, sz1, dp1)
+        ch2, sz2, dp2 = tree_structure_arrays(c2s)
+        cons2 = check_constraints_batch(c2s, options, tables, cur_maxsize, ch2, sz2, dp2)
+        pair_valid = ok1s & ok2s & cons1 & cons2
+        xo1, xo_success = _first_valid(pair_valid, c1s, m1.trees)
+        xo2, _ = _first_valid(pair_valid, c2s, m2.trees)
+
+        cand1 = M._select_tree(is_xover, xo1, mut_tree)
+        cand2 = xo2
+        needs_eval1 = jnp.where(is_xover, xo_success, mut_success & ~immediate)
+        needs_eval2 = is_xover & xo_success
+        return (
+            is_xover, i1, i2, kind, immediate, mut_success, xo_success,
+            cand1, cand2, needs_eval1, needs_eval2, ks[6],
+        )
+
+    (is_xover, i1, i2, kind, immediate, mut_success, xo_success,
+     cand1, cand2, needs_eval1, needs_eval2, accept_keys) = jax.vmap(slot_fn)(keys)
+
+    # ---- one fused eval launch over all candidates ----
+    both = jax.tree.map(
+        lambda a, b: jnp.stack([a, b], axis=1), cand1, cand2
+    )  # [B, 2, ...]
+    cost, loss, complexity = eval_cost_batch(
+        both, data, elementwise_loss, tables, cfg.operators, cfg.parsimony,
+        batch_idx=batch_idx,
+    )
+    needs_eval = jnp.stack([needs_eval1, needs_eval2], axis=1)
+    num_evals = jnp.sum(needs_eval.astype(jnp.float32))
+
+    # ---- accept logic (src/Mutate.jl:270-355) ----
+    m1_cost = pop.cost[i1]
+    m1_loss = pop.loss[i1]
+    m1_complexity = pop.complexity[i1]
+    after_cost = cost[:, 0]
+    after_loss = loss[:, 0]
+    after_cx = complexity[:, 0]
+
+    prob = jnp.ones_like(after_cost)
+    if cfg.annealing:
+        delta = after_cost - m1_cost
+        prob = prob * jnp.exp(-delta / (cfg.alpha * temperature + 1e-12))
+    if cfg.use_frequency:
+        def freq_of(sz):
+            in_r = (sz > 0) & (sz <= cfg.maxsize)
+            return jnp.where(
+                in_r, stats_nf[jnp.clip(sz - 1, 0, cfg.maxsize - 1)], 1e-6
+            )
+        prob = prob * (freq_of(m1_complexity) / jnp.maximum(freq_of(after_cx), 1e-12)
+                       ).astype(prob.dtype)
+    u = jax.vmap(lambda k: jax.random.uniform(k))(accept_keys)
+    anneal_ok = u < jnp.where(jnp.isnan(prob), 0.0, prob)
+    accepted_mut = mut_success & ~jnp.isnan(after_cost) & anneal_ok
+
+    # Immediate kinds always "accept" the (unchanged) member, keeping its
+    # cost/loss (do_nothing / simplify / optimize, src/Mutate.jl:571-658).
+    mut_replace = jnp.where(
+        immediate, jnp.bool_(True),
+        jnp.where(accepted_mut, True, ~jnp.bool_(cfg.skip_mutation_failures)),
+    )
+    baby1_tree = M._select_tree(
+        (accepted_mut & ~immediate)[:, None], cand1, pop.member(i1).trees
+    )
+    baby1_cost = jnp.where(accepted_mut & ~immediate, after_cost, m1_cost)
+    baby1_loss = jnp.where(accepted_mut & ~immediate, after_loss, m1_loss)
+    baby1_cx = jnp.where(accepted_mut & ~immediate, after_cx, m1_complexity)
+
+    # Crossover babies replace unconditionally when constraints passed
+    # (crossover_generation, src/Mutate.jl:661-733).
+    xo_nan = jnp.isnan(cost[:, 0]) | jnp.isnan(cost[:, 1])
+    xo_replace = xo_success & ~xo_nan
+
+    replace1 = jnp.where(is_xover, xo_replace, mut_replace)
+    replace2 = is_xover & xo_replace
+    baby1_tree = M._select_tree(is_xover[:, None], cand1, baby1_tree)
+    baby1_cost = jnp.where(is_xover, cost[:, 0], baby1_cost)
+    baby1_loss = jnp.where(is_xover, loss[:, 0], baby1_loss)
+    baby1_cx = jnp.where(is_xover, complexity[:, 0], baby1_cx)
+
+    babies = jax.tree.map(lambda a, b: jnp.stack([a, b], axis=1), baby1_tree, cand2)
+    baby_cost = jnp.stack([baby1_cost, cost[:, 1]], axis=1)
+    baby_loss = jnp.stack([baby1_loss, loss[:, 1]], axis=1)
+    baby_cx = jnp.stack([baby1_cx, complexity[:, 1]], axis=1)
+    baby_parent = jnp.stack([pop.ref[i1], pop.ref[i2]], axis=1)
+    replace = jnp.stack([replace1, replace2], axis=1)  # [B, 2]
+
+    # ---- replace oldest members (distinct targets per baby) ----
+    flat_replace = replace.reshape(-1)
+    nb = flat_replace.shape[0]
+    flat_babies = jax.tree.map(lambda x: x.reshape(nb, *x.shape[2:]), babies)
+    order = jnp.argsort(pop.birth)  # oldest first
+    rank = jnp.cumsum(flat_replace.astype(jnp.int32)) - 1
+    target = jnp.where(
+        flat_replace, order[jnp.clip(rank, 0, P - 1)], P  # P = drop slot
+    )
+
+    def scatter(dst, src):
+        return dst.at[target].set(src, mode="drop")
+
+    new_trees = TreeBatch(
+        arity=scatter(pop.trees.arity, flat_babies.arity),
+        op=scatter(pop.trees.op, flat_babies.op),
+        feat=scatter(pop.trees.feat, flat_babies.feat),
+        const=scatter(pop.trees.const, flat_babies.const),
+        length=scatter(pop.trees.length, flat_babies.length),
+    )
+    new_birth = birth0 + jnp.arange(nb, dtype=jnp.int32)
+    new_ref = ref0 + jnp.arange(nb, dtype=jnp.int32)
+    new_pop = PopulationState(
+        trees=new_trees,
+        cost=scatter(pop.cost, baby_cost.reshape(-1)),
+        loss=scatter(pop.loss, baby_loss.reshape(-1)),
+        complexity=scatter(pop.complexity, baby_cx.reshape(-1)),
+        birth=scatter(pop.birth, new_birth),
+        ref=scatter(pop.ref, new_ref),
+        parent=scatter(pop.parent, baby_parent.reshape(-1)),
+    )
+    return new_pop, num_evals, birth0 + nb, ref0 + nb
+
+
+# ---------------------------------------------------------------------------
+# Best-seen hall of fame (per complexity), device-resident
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class HofState:
+    trees: TreeBatch      # [..., maxsize, L]
+    cost: jax.Array       # [..., maxsize]
+    loss: jax.Array       # [..., maxsize]
+    complexity: jax.Array  # [..., maxsize] int32
+    exists: jax.Array     # [..., maxsize] bool
+
+
+def empty_hof(maxsize: int, max_nodes: int, dtype) -> HofState:
+    return HofState(
+        trees=TreeBatch.empty((maxsize,), max_nodes, dtype),
+        cost=jnp.full((maxsize,), jnp.inf, dtype),
+        loss=jnp.full((maxsize,), jnp.inf, dtype),
+        complexity=jnp.zeros((maxsize,), jnp.int32),
+        exists=jnp.zeros((maxsize,), jnp.bool_),
+    )
+
+
+def update_hof(hof: HofState, pop: PopulationState, maxsize: int) -> HofState:
+    """Per-complexity best update (s_r_cycle's best_examples_seen,
+    src/SingleIteration.jl:53-62). Unbatched (single island)."""
+    P = pop.cost.shape[-1]
+    sizes = jnp.arange(1, maxsize + 1)[:, None]  # [maxsize, 1]
+    m = (pop.complexity[None, :] == sizes)       # [maxsize, P]
+    cost_m = jnp.where(m, pop.cost[None, :], jnp.inf)
+    best_idx = jnp.argmin(cost_m, axis=1)
+    best_cost = jnp.take_along_axis(cost_m, best_idx[:, None], axis=1)[:, 0]
+    better = best_cost < hof.cost
+
+    def pick(hof_field, pop_field):
+        gathered = jnp.take(pop_field, best_idx, axis=0)
+        shape = (maxsize,) + (1,) * (gathered.ndim - 1)
+        return jnp.where(better.reshape(shape), gathered, hof_field)
+
+    return HofState(
+        trees=TreeBatch(
+            arity=pick(hof.trees.arity, pop.trees.arity),
+            op=pick(hof.trees.op, pop.trees.op),
+            feat=pick(hof.trees.feat, pop.trees.feat),
+            const=pick(hof.trees.const, pop.trees.const),
+            length=pick(hof.trees.length, pop.trees.length),
+        ),
+        cost=jnp.where(better, best_cost, hof.cost),
+        loss=pick(hof.loss, pop.loss),
+        complexity=pick(hof.complexity, pop.complexity),
+        exists=hof.exists | better,
+    )
+
+
+def s_r_cycle(
+    key,
+    pop: PopulationState,
+    data,
+    stats_nf,
+    cur_maxsize,
+    birth0,
+    ref0,
+    cfg: EvolveConfig,
+    options: Options,
+    tables: ComplexityTables,
+    elementwise_loss,
+    batch_idx=None,
+):
+    """ncycles generation steps over the annealing ramp; returns
+    (pop, best_seen_hof, num_evals, birth0, ref0)."""
+    ncycles = cfg.ncycles
+    hof0 = empty_hof(cfg.maxsize, cfg.max_nodes, pop.cost.dtype)
+
+    def cycle(carry, c):
+        pop, hof, birth, ref, nev = carry
+        if cfg.annealing and ncycles > 1:
+            temperature = 1.0 - c.astype(pop.cost.dtype) / (ncycles - 1)
+        else:
+            temperature = jnp.asarray(1.0, pop.cost.dtype)
+        k = jax.random.fold_in(key, c)
+        pop, nev_c, birth, ref = generation_step(
+            k, pop, data, stats_nf, temperature, cur_maxsize, birth, ref,
+            cfg, options, tables, elementwise_loss, batch_idx=batch_idx,
+        )
+        hof = update_hof(hof, pop, cfg.maxsize)
+        return (pop, hof, birth, ref, nev + nev_c), None
+
+    (pop, hof, birth0, ref0, num_evals), _ = jax.lax.scan(
+        cycle, (pop, hof0, birth0, ref0, jnp.float32(0.0)),
+        jnp.arange(ncycles, dtype=jnp.int32),
+    )
+    return pop, hof, num_evals, birth0, ref0

@@ -1,0 +1,51 @@
+"""Tournament selection (best_of_sample, src/Population.jl:109-180).
+
+Sample `tournament_selection_n` members without replacement, adjust costs
+by the adaptive-parsimony frequency factor ``cost * exp(scaling * freq)``,
+then pick the k-th best where k follows the truncated geometric place
+distribution ``p (1-p)^k`` (src/Population.jl:145-179).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["tournament_select"]
+
+
+def tournament_select(
+    key,
+    cost: jax.Array,          # [P]
+    complexity: jax.Array,    # [P] int32
+    normalized_frequencies,   # [maxsize] (index 0 => complexity 1)
+    *,
+    tournament_n: int,
+    p: float,
+    use_frequency: bool,
+    adaptive_parsimony_scaling: float,
+    maxsize: int,
+) -> jax.Array:
+    """Return the selected member index."""
+    P = cost.shape[0]
+    k1, k2 = jax.random.split(key)
+    picks = jax.random.permutation(k1, P)[:tournament_n]
+    c = cost[picks]
+    if use_frequency:
+        size = complexity[picks]
+        in_range = (size > 0) & (size <= maxsize)
+        freq = jnp.where(
+            in_range,
+            normalized_frequencies[jnp.clip(size - 1, 0, maxsize - 1)],
+            0.0,
+        )
+        c = c * jnp.exp(adaptive_parsimony_scaling * freq).astype(c.dtype)
+    # NaN costs must never win a tournament:
+    c = jnp.where(jnp.isnan(c), jnp.inf, c)
+    if p >= 1.0:
+        return picks[jnp.argmin(c)]
+    ks = jnp.arange(tournament_n)
+    place_weights = p * (1 - p) ** ks
+    place = jax.random.categorical(k2, jnp.log(place_weights))
+    order = jnp.argsort(c)
+    return picks[order[place]]

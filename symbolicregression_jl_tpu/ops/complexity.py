@@ -1,0 +1,189 @@
+"""Device-side complexity and constraint checking.
+
+Tensorized equivalents of src/Complexity.jl (compute_complexity over a
+ComplexityMapping) and src/CheckConstraints.jl (maxsize / maxdepth /
+per-operator argument-size constraints / nested-operator constraints).
+All checks run batched over candidate trees inside the jitted generation
+step — the reference's post-mutation rejection loop becomes a boolean mask.
+
+The postfix encoding makes subtree aggregates cheap: a subtree is the
+contiguous slot range ``[k - size_k + 1, k]``, so subtree sums are prefix
+sum differences; "max along any path" quantities use one O(L) stack scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.options import Options
+from .encoding import LEAF_CONST, LEAF_PARAM, LEAF_VAR, MAX_ARITY, TreeBatch
+
+__all__ = ["ComplexityTables", "build_complexity_tables", "compute_complexity_batch",
+           "check_constraints_batch"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ComplexityTables:
+    unary_w: jax.Array    # [max(U,1)]
+    binary_w: jax.Array   # [max(B,1)]
+    variable_w: jax.Array  # [nfeatures]
+    constant_w: jax.Array  # scalar
+
+
+def build_complexity_tables(options: Options, nfeatures: int) -> ComplexityTables:
+    cm = options.complexity_mapping
+    U = max(len(options.operators.unary), 1)
+    B = max(len(options.operators.binary), 1)
+    un = np.ones(U, np.float32)
+    bi = np.ones(B, np.float32)
+    if cm.use:
+        for i, w in enumerate(cm.op_complexities.get(1, [])):
+            un[i] = w
+        for i, w in enumerate(cm.op_complexities.get(2, [])):
+            bi[i] = w
+    if isinstance(cm.variable_complexity, list):
+        var = np.asarray(cm.variable_complexity, np.float32)
+        if var.shape[0] != nfeatures:
+            raise ValueError(
+                f"complexity_of_variables has {var.shape[0]} entries; expected {nfeatures}"
+            )
+    else:
+        var = np.full(nfeatures, cm.variable_complexity, np.float32)
+    return ComplexityTables(
+        unary_w=jnp.asarray(un),
+        binary_w=jnp.asarray(bi),
+        variable_w=jnp.asarray(var),
+        constant_w=jnp.asarray(np.float32(cm.constant_complexity)),
+    )
+
+
+def _node_weights(batch: TreeBatch, tables: ComplexityTables) -> jax.Array:
+    """Per-slot complexity weight (garbage at padded slots; callers mask)."""
+    a, o, f = batch.arity, batch.op, batch.feat
+    nF = tables.variable_w.shape[0]
+    leaf_w = jnp.where(
+        o == LEAF_CONST,
+        tables.constant_w,
+        tables.variable_w[jnp.clip(f, 0, nF - 1)],
+    )
+    un_w = tables.unary_w[jnp.clip(o, 0, tables.unary_w.shape[0] - 1)]
+    bi_w = tables.binary_w[jnp.clip(o, 0, tables.binary_w.shape[0] - 1)]
+    return jnp.where(a == 0, leaf_w, jnp.where(a == 1, un_w, bi_w))
+
+
+def compute_complexity_batch(batch: TreeBatch, tables: ComplexityTables) -> jax.Array:
+    """Rounded-int complexity per tree (src/Complexity.jl:20-63)."""
+    w = _node_weights(batch, tables)
+    L = batch.max_nodes
+    mask = jnp.arange(L) < batch.length[..., None]
+    raw = jnp.sum(jnp.where(mask, w, 0.0), axis=-1)
+    return jnp.round(raw).astype(jnp.int32)
+
+
+def _postfix_max_plus(vals: jax.Array, arity: jax.Array) -> jax.Array:
+    """r[k] = vals[k] + max(r[children of k], default 0) — one stack scan.
+
+    Computes, for each node, the maximum sum of `vals` along any root-to-leaf
+    path *within its subtree* (the tree_mapreduce pattern at
+    /root/reference/src/CheckConstraints.jl:34-46). Unbatched [L] arrays.
+    """
+    L = arity.shape[0]
+
+    def step(carry, k):
+        stack, sp = carry
+        a = arity[k]
+        best = jnp.zeros((), vals.dtype)
+        for j in range(MAX_ARITY):
+            pos = sp - a + j
+            valid = j < a
+            best = jnp.maximum(best, jnp.where(valid, stack[jnp.maximum(pos, 0)], 0))
+        r_k = vals[k] + best
+        new_sp = sp - a + 1
+        stack = stack.at[new_sp - 1].set(r_k)
+        return (stack, new_sp), r_k
+
+    init = (jnp.zeros((L,), vals.dtype), jnp.int32(0))
+    _, r = jax.lax.scan(step, init, jnp.arange(L, dtype=jnp.int32))
+    return r
+
+
+def _subtree_sums(w: jax.Array, size: jax.Array) -> jax.Array:
+    """Subtree sums via the contiguous-span prefix-sum trick. Unbatched [L]."""
+    csum = jnp.concatenate([jnp.zeros((1,), w.dtype), jnp.cumsum(w)])
+    k = jnp.arange(w.shape[0])
+    start = k - size + 1
+    return csum[k + 1] - csum[jnp.clip(start, 0, None)]
+
+
+def check_constraints_batch(
+    batch: TreeBatch,
+    options: Options,
+    tables: ComplexityTables,
+    cur_maxsize: jax.Array,
+    child: jax.Array,
+    size: jax.Array,
+    depth: jax.Array,
+) -> jax.Array:
+    """Vectorized check_constraints (src/CheckConstraints.jl:66-96).
+
+    `child/size/depth` come from `tree_structure_arrays`. Returns bool[...]
+    (True = satisfies all constraints).
+    """
+    L = batch.max_nodes
+    batch_shape = batch.batch_shape
+    slot = jnp.arange(L)
+    mask = slot < batch.length[..., None]
+
+    complexity = compute_complexity_batch(batch, tables)
+    ok = complexity <= cur_maxsize
+
+    root_depth = jnp.max(jnp.where(mask, depth, 0), axis=-1)
+    ok = ok & (root_depth <= options.maxdepth)
+
+    # Per-operator argument-size constraints
+    # (flag_operator_complexity, src/CheckConstraints.jl:14-32).
+    has_op_cons = any(
+        any(c != -1 for c in cons)
+        for d, conslist in options.op_constraints.items()
+        for cons in conslist
+    )
+    if has_op_cons or options.nested_constraints:
+        w = _node_weights(batch, tables)
+        flat_w = w.reshape(-1, L)
+        flat_size = size.reshape(-1, L)
+        sub_cx = jax.vmap(_subtree_sums)(flat_w, flat_size).reshape(*batch_shape, L)
+
+    if has_op_cons:
+        for d, conslist in options.op_constraints.items():
+            for op_idx, cons in enumerate(conslist):
+                if all(c == -1 for c in cons):
+                    continue
+                is_target = mask & (batch.arity == d) & (batch.op == op_idx)
+                for j, limit in enumerate(cons):
+                    if limit == -1:
+                        continue
+                    cj = child[..., j]
+                    child_cx = jnp.take_along_axis(sub_cx, cj, axis=-1)
+                    violation = is_target & (jnp.round(child_cx) > limit)
+                    ok = ok & ~jnp.any(violation, axis=-1)
+
+    # Nested-operator constraints (flag_illegal_nests, :49-63).
+    for (d, op_idx, inners) in options.nested_constraints:
+        is_outer = mask & (batch.arity == d) & (batch.op == op_idx)
+        for (nd, ni, max_nest) in inners:
+            is_inner = (mask & (batch.arity == nd) & (batch.op == ni)).astype(jnp.int32)
+            flat_inner = is_inner.reshape(-1, L)
+            flat_arity = batch.arity.reshape(-1, L)
+            r = jax.vmap(_postfix_max_plus)(flat_inner, flat_arity)
+            r = r.reshape(*batch_shape, L)
+            nestedness = r - is_inner  # exclude self-match (:44-45)
+            violation = is_outer & (nestedness > max_nest)
+            ok = ok & ~jnp.any(violation, axis=-1)
+
+    return ok

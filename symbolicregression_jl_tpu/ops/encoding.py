@@ -1,0 +1,282 @@
+"""Postfix tensor encoding of expression-tree populations.
+
+This replaces the reference's pointer-based `Node` populations with padded
+arrays so that a whole population is evaluated/mutated in a single XLA
+launch (SURVEY.md §7 design delta 1). Trees are stored in depth-first
+*post-order* ("postfix"), which has the key property that **every subtree
+occupies a contiguous slot range** ``[k - size_k + 1, k]`` — structural
+mutations (insert/delete/crossover/rotate) become gather index arithmetic
+instead of pointer surgery.
+
+Per-tree arrays (slot axis L = maxsize, padded):
+
+- ``arity[L]``  int32: 0 for leaves, d for arity-d operator nodes. Padding
+  slots (``k >= length``) hold arity 0.
+- ``op[L]``     int32: for leaves: 0=constant, 1=variable, 2=parameter
+  (LEAF_CONST/LEAF_VAR/LEAF_PARAM); for operator nodes: index into the
+  OperatorSet's arity-d table.
+- ``feat[L]``   int32: feature index for variable leaves (0-based);
+  parameter index for parameter leaves.
+- ``const[L]``  float: constant value for constant leaves.
+- ``length``    int32 scalar: number of used slots; root is ``length - 1``.
+
+A batch stacks these with arbitrary leading dims (population, island, ...).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .operators import OperatorSet
+from .tree import Node
+
+__all__ = [
+    "LEAF_CONST",
+    "LEAF_VAR",
+    "LEAF_PARAM",
+    "TreeBatch",
+    "encode_tree",
+    "decode_tree",
+    "encode_population",
+    "tree_structure_arrays",
+]
+
+LEAF_CONST = 0
+LEAF_VAR = 1
+LEAF_PARAM = 2
+
+MAX_ARITY = 2  # reference default node degree; bump for n-ary operator sets
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TreeBatch:
+    """A batch of postfix-encoded trees (pytree of arrays).
+
+    All fields share leading batch dims; the final axis of the per-slot
+    fields is the slot axis L.
+    """
+
+    arity: jax.Array  # int32 [..., L]
+    op: jax.Array     # int32 [..., L]
+    feat: jax.Array   # int32 [..., L]
+    const: jax.Array  # float [..., L]
+    length: jax.Array  # int32 [...]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.arity.shape[-1]
+
+    @property
+    def batch_shape(self) -> Tuple[int, ...]:
+        return self.arity.shape[:-1]
+
+    def reshape(self, *batch_shape) -> "TreeBatch":
+        L = self.max_nodes
+        return TreeBatch(
+            arity=self.arity.reshape(*batch_shape, L),
+            op=self.op.reshape(*batch_shape, L),
+            feat=self.feat.reshape(*batch_shape, L),
+            const=self.const.reshape(*batch_shape, L),
+            length=self.length.reshape(*batch_shape),
+        )
+
+    def __getitem__(self, idx) -> "TreeBatch":
+        return TreeBatch(
+            arity=self.arity[idx],
+            op=self.op[idx],
+            feat=self.feat[idx],
+            const=self.const[idx],
+            length=self.length[idx],
+        )
+
+    @staticmethod
+    def empty(batch_shape: Tuple[int, ...], max_nodes: int, dtype=jnp.float32) -> "TreeBatch":
+        """All-padding batch of single-constant (0.0) trees."""
+        shape = (*batch_shape, max_nodes)
+        return TreeBatch(
+            arity=jnp.zeros(shape, jnp.int32),
+            op=jnp.zeros(shape, jnp.int32),
+            feat=jnp.zeros(shape, jnp.int32),
+            const=jnp.zeros(shape, dtype),
+            length=jnp.ones(batch_shape, jnp.int32),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Host encode / decode
+# ---------------------------------------------------------------------------
+
+
+def encode_tree(
+    tree: Node, max_nodes: int, operators: OperatorSet, dtype=np.float32
+):
+    """Encode a host `Node` into per-slot numpy arrays (postfix order)."""
+    arity = np.zeros(max_nodes, np.int32)
+    op = np.zeros(max_nodes, np.int32)
+    feat = np.zeros(max_nodes, np.int32)
+    const = np.zeros(max_nodes, dtype)
+    k = 0
+    for n in tree.nodes():
+        if k >= max_nodes:
+            raise ValueError(
+                f"Tree has more than max_nodes={max_nodes} nodes: "
+                f"{tree.count_nodes()}"
+            )
+        arity[k] = n.degree
+        if n.degree == 0:
+            if n.is_parameter:
+                op[k] = LEAF_PARAM
+                feat[k] = n.parameter
+            elif n.constant:
+                op[k] = LEAF_CONST
+                const[k] = n.val
+            else:
+                op[k] = LEAF_VAR
+                feat[k] = n.feature
+        else:
+            ops_d = operators[n.degree]
+            idx = None
+            for i, o in enumerate(ops_d):
+                if o.name == n.op.name:
+                    idx = i
+                    break
+            if idx is None:
+                raise ValueError(
+                    f"Operator {n.op.name!r}/{n.degree} not in operator set"
+                )
+            op[k] = idx
+        k += 1
+    return arity, op, feat, const, np.int32(k)
+
+
+def decode_tree(arity, op, feat, const, length, operators: OperatorSet) -> Node:
+    """Decode per-slot arrays back into a host `Node` (inverse of encode)."""
+    arity = np.asarray(arity)
+    op = np.asarray(op)
+    feat = np.asarray(feat)
+    const = np.asarray(const)
+    length = int(length)
+    stack: List[Node] = []
+    for k in range(length):
+        a = int(arity[k])
+        if a == 0:
+            code = int(op[k])
+            if code == LEAF_CONST:
+                stack.append(Node.const(float(const[k])))
+            elif code == LEAF_VAR:
+                stack.append(Node.var(int(feat[k])))
+            else:
+                stack.append(Node.param(int(feat[k])))
+        else:
+            children = stack[-a:]
+            del stack[-a:]
+            stack.append(Node(op=operators[a][int(op[k])], children=children))
+    if len(stack) != 1:
+        raise ValueError(f"Malformed postfix encoding (stack={len(stack)})")
+    return stack[0]
+
+
+def encode_population(
+    trees: Sequence[Node], max_nodes: int, operators: OperatorSet, dtype=np.float32
+) -> TreeBatch:
+    n = len(trees)
+    arity = np.zeros((n, max_nodes), np.int32)
+    op = np.zeros((n, max_nodes), np.int32)
+    feat = np.zeros((n, max_nodes), np.int32)
+    const = np.zeros((n, max_nodes), dtype)
+    length = np.zeros((n,), np.int32)
+    for i, t in enumerate(trees):
+        arity[i], op[i], feat[i], const[i], length[i] = encode_tree(
+            t, max_nodes, operators, dtype
+        )
+    return TreeBatch(
+        arity=jnp.asarray(arity),
+        op=jnp.asarray(op),
+        feat=jnp.asarray(feat),
+        const=jnp.asarray(const),
+        length=jnp.asarray(length),
+    )
+
+
+def decode_population(batch: TreeBatch, operators: OperatorSet) -> List[Node]:
+    """Decode a TreeBatch (flattened over leading dims) into host Nodes."""
+    flat = batch.reshape(int(np.prod(batch.batch_shape)) if batch.batch_shape else 1)
+    arity = np.asarray(flat.arity)
+    op = np.asarray(flat.op)
+    feat = np.asarray(flat.feat)
+    const = np.asarray(flat.const)
+    length = np.asarray(flat.length)
+    return [
+        decode_tree(arity[i], op[i], feat[i], const[i], length[i], operators)
+        for i in range(arity.shape[0])
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Device-side structural derivation
+# ---------------------------------------------------------------------------
+
+
+def _tree_structure_single(arity: jax.Array, length: jax.Array):
+    """Derive (child, size, depth) for one postfix tree — O(L) scan.
+
+    child[k, j] = slot index of the j-th child of node k (0 where unused);
+    size[k] = subtree node count; depth[k] = subtree depth. Padding slots
+    produce size 1 / depth 1 / children 0 and are never read by consumers
+    that respect ``length``.
+    """
+    L = arity.shape[0]
+
+    def step(carry, k):
+        stack_idx, stack_size, stack_depth, sp = carry
+        a = arity[k]
+        # children are the top `a` stack entries; child j (1-based left..right)
+        # sits at stack position sp - a + j.
+        child_k = jnp.zeros((MAX_ARITY,), jnp.int32)
+        size_k = jnp.int32(1)
+        depth_k = jnp.int32(0)
+        for j in range(MAX_ARITY):
+            pos = sp - a + j
+            valid = j < a
+            idx = jnp.where(valid, stack_idx[jnp.maximum(pos, 0)], 0)
+            child_k = child_k.at[j].set(jnp.where(valid, idx, 0))
+            size_k = size_k + jnp.where(valid, stack_size[jnp.maximum(pos, 0)], 0)
+            depth_k = jnp.maximum(
+                depth_k, jnp.where(valid, stack_depth[jnp.maximum(pos, 0)], 0)
+            )
+        depth_k = depth_k + 1
+        new_sp = sp - a + 1
+        top = new_sp - 1
+        stack_idx = stack_idx.at[top].set(k)
+        stack_size = stack_size.at[top].set(size_k)
+        stack_depth = stack_depth.at[top].set(depth_k)
+        return (stack_idx, stack_size, stack_depth, new_sp), (child_k, size_k, depth_k)
+
+    init = (
+        jnp.zeros((L,), jnp.int32),
+        jnp.zeros((L,), jnp.int32),
+        jnp.zeros((L,), jnp.int32),
+        jnp.int32(0),
+    )
+    _, (child, size, depth) = jax.lax.scan(step, init, jnp.arange(L, dtype=jnp.int32))
+    return child, size, depth
+
+
+def tree_structure_arrays(batch: TreeBatch):
+    """Batched (child, size, depth) derivation; auto-vmaps leading dims."""
+    batch_shape = batch.batch_shape
+    flat_arity = batch.arity.reshape(-1, batch.max_nodes)
+    flat_len = batch.length.reshape(-1)
+    child, size, depth = jax.vmap(_tree_structure_single)(flat_arity, flat_len)
+    return (
+        child.reshape(*batch_shape, batch.max_nodes, MAX_ARITY),
+        size.reshape(*batch_shape, batch.max_nodes),
+        depth.reshape(*batch_shape, batch.max_nodes),
+    )

@@ -1,0 +1,149 @@
+"""Vmapped postfix tree interpreter — the framework's hot kernel.
+
+Replaces DynamicExpressions' recursive fused interpreter
+(/root/reference/src/InterfaceDynamicExpressions.jl:32-44) with an iterative
+slot-buffer interpreter: a `lax.scan` over tree slots, each step gathering
+child rows from the value buffer, applying the operator tables, and writing
+back. One XLA launch evaluates ``population × rows`` values (SURVEY.md §7).
+
+NaN/Inf early-exit semantics (invalid => loss Inf,
+/root/reference/src/LossFunctions.jl:96-99) are replaced by an equivalent
+masked validity reduction: a tree is invalid iff *any* node's output
+contains a non-finite value over the evaluated rows — matching the
+reference, which checks each op's output buffer before continuing.
+
+`jax.grad` through this interpreter (w.r.t. the `const` leaf array) powers
+constant optimization, replacing Enzyme/Mooncake reverse-mode AD
+(/root/reference/src/ConstantOptimization.jl:136-167).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .encoding import (
+    LEAF_CONST,
+    LEAF_PARAM,
+    LEAF_VAR,
+    MAX_ARITY,
+    TreeBatch,
+    tree_structure_arrays,
+)
+from .operators import OperatorSet
+
+__all__ = ["eval_tree_batch", "eval_single_tree"]
+
+
+def _apply_tables(operators: OperatorSet, a, o, leaf, children):
+    """Value of one node: select over arity and operator index.
+
+    Computes every operator of the relevant arity and selects by index —
+    under vmap a `lax.switch` would lower to the same select tree, so we
+    generate it directly and let XLA fuse the elementwise ops.
+    """
+    val = leaf
+    unary_ops = operators.unary
+    binary_ops = operators.binary
+    if unary_ops:
+        un_stack = jnp.stack([op.fn(children[0]) for op in unary_ops])
+        un = jax.lax.dynamic_index_in_dim(
+            un_stack, jnp.clip(o, 0, len(unary_ops) - 1), axis=0, keepdims=False
+        )
+        val = jnp.where(a == 1, un, val)
+    if binary_ops:
+        bi_stack = jnp.stack([op.fn(children[0], children[1]) for op in binary_ops])
+        bi = jax.lax.dynamic_index_in_dim(
+            bi_stack, jnp.clip(o, 0, len(binary_ops) - 1), axis=0, keepdims=False
+        )
+        val = jnp.where(a == 2, bi, val)
+    return val
+
+
+def eval_single_tree(
+    arity: jax.Array,
+    op: jax.Array,
+    feat: jax.Array,
+    const: jax.Array,
+    length: jax.Array,
+    child: jax.Array,
+    X: jax.Array,  # [F, n]
+    operators: OperatorSet,
+    params: Optional[jax.Array] = None,  # [n_params, n] (pre-gathered by class)
+) -> Tuple[jax.Array, jax.Array]:
+    """Evaluate one postfix tree over all rows. Returns (y[n], valid)."""
+    L = arity.shape[0]
+    n = X.shape[1]
+    dtype = const.dtype
+
+    def step(carry, k):
+        buf, valid = carry
+        a = arity[k]
+        o = op[k]
+        children = [
+            jax.lax.dynamic_index_in_dim(buf, child[k, j], axis=0, keepdims=False)
+            for j in range(MAX_ARITY)
+        ]
+        x_row = jax.lax.dynamic_index_in_dim(X, feat[k], axis=0, keepdims=False)
+        leaf = jnp.where(o == LEAF_CONST, jnp.broadcast_to(const[k], (n,)), x_row)
+        if params is not None:
+            p_row = jax.lax.dynamic_index_in_dim(
+                params, jnp.clip(feat[k], 0, params.shape[0] - 1), axis=0, keepdims=False
+            )
+            leaf = jnp.where(o == LEAF_PARAM, p_row, leaf)
+        else:
+            # A parameter leaf evaluated without parameters is invalid, not
+            # a silent read of X[feat].
+            leaf = jnp.where((a == 0) & (o == LEAF_PARAM), jnp.nan, leaf)
+        val = _apply_tables(operators, a, o, leaf, children)
+        val = val.astype(dtype)
+        in_tree = k < length
+        valid = valid & (jnp.all(jnp.isfinite(val)) | ~in_tree)
+        buf = buf.at[k].set(val)
+        return (buf, valid), None
+
+    buf0 = jnp.zeros((L, n), dtype)
+    (buf, valid), _ = jax.lax.scan(
+        step, (buf0, jnp.bool_(True)), jnp.arange(L, dtype=jnp.int32)
+    )
+    y = jax.lax.dynamic_index_in_dim(buf, length - 1, axis=0, keepdims=False)
+    return y, valid
+
+
+@partial(jax.jit, static_argnames=("operators",))
+def eval_tree_batch(
+    batch: TreeBatch,
+    X: jax.Array,  # [F, n]
+    operators: OperatorSet,
+    params: Optional[jax.Array] = None,  # [..., n_params, n] or None
+) -> Tuple[jax.Array, jax.Array]:
+    """Evaluate a batch of trees over all rows.
+
+    Returns ``(y[..., n], valid[...])`` with the batch's leading dims.
+    """
+    batch_shape = batch.batch_shape
+    L = batch.max_nodes
+    flat = batch.reshape(-1)
+    child, _, _ = tree_structure_arrays(flat)
+
+    if params is None:
+        f = jax.vmap(
+            lambda a, o, ft, c, ln, ch: eval_single_tree(
+                a, o, ft, c, ln, ch, X, operators
+            )
+        )
+        y, valid = f(flat.arity, flat.op, flat.feat, flat.const, flat.length, child)
+    else:
+        p_flat = params.reshape(-1, *params.shape[-2:])
+        f = jax.vmap(
+            lambda a, o, ft, c, ln, ch, p: eval_single_tree(
+                a, o, ft, c, ln, ch, X, operators, p
+            )
+        )
+        y, valid = f(
+            flat.arity, flat.op, flat.feat, flat.const, flat.length, child, p_flat
+        )
+    return y.reshape(*batch_shape, X.shape[1]), valid.reshape(batch_shape)

@@ -1,0 +1,418 @@
+"""Operator registry with domain-safe semantics.
+
+TPU-native analogue of the reference's operator layer
+(/root/reference/src/Operators.jl:35-124 and DynamicExpressions' OperatorEnum).
+Every operator is a JAX-traceable elementwise function returning NaN outside
+its domain, so that invalid expressions are detected by a masked validity
+reduction instead of the reference's early-exit interpreter
+(/root/reference/src/InterfaceDynamicExpressions.jl:32-44).
+
+Operators are organized by arity into an :class:`OperatorSet` (the
+`OperatorEnum` equivalent); mutation sampling uses `OperatorSet.nops` the
+same way the reference uses `options.nops`
+(/root/reference/src/MutationFunctions.jl:209-225).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Op",
+    "OperatorSet",
+    "resolve_operator",
+    "DEFAULT_BINARY",
+    "DEFAULT_UNARY",
+    "OPERATOR_REGISTRY",
+]
+
+
+def _nan_like(x):
+    return jnp.full_like(x, jnp.nan)
+
+
+# ---------------------------------------------------------------------------
+# Safe scalar operators (NaN outside domain), mirroring
+# /root/reference/src/Operators.jl:35-124.
+# ---------------------------------------------------------------------------
+
+
+def safe_pow(x, y):
+    """`x^y` with NaN where the real power is undefined.
+
+    Mirrors /root/reference/src/Operators.jl:35-49: integer exponents allow
+    negative bases (0^negative is NaN); non-integer exponents require a
+    positive base (or zero base with positive exponent).
+    """
+    is_int = y == jnp.round(y)
+    is_odd = jnp.abs(jnp.mod(y, 2.0)) == 1.0
+    # Integer-exponent path: compute |x|^y and restore sign for odd powers.
+    mag = jnp.abs(x) ** y
+    signed = jnp.where(is_odd & (x < 0), -mag, mag)
+    int_res = jnp.where((y < 0) & (x == 0), jnp.nan, signed)
+    # Non-integer path: domain requires x > 0 (x == 0 ok for y > 0).
+    bad = ((y > 0) & (x < 0)) | ((y < 0) & (x <= 0))
+    nonint_res = jnp.where(bad, jnp.nan, jnp.abs(x) ** y)
+    return jnp.where(is_int, int_res, nonint_res)
+
+
+def safe_log(x):
+    return jnp.where(x > 0, jnp.log(jnp.where(x > 0, x, 1.0)), jnp.nan)
+
+
+def safe_log2(x):
+    return jnp.where(x > 0, jnp.log2(jnp.where(x > 0, x, 1.0)), jnp.nan)
+
+
+def safe_log10(x):
+    return jnp.where(x > 0, jnp.log10(jnp.where(x > 0, x, 1.0)), jnp.nan)
+
+
+def safe_log1p(x):
+    return jnp.where(x > -1, jnp.log1p(jnp.where(x > -1, x, 0.0)), jnp.nan)
+
+
+def safe_sqrt(x):
+    return jnp.where(x >= 0, jnp.sqrt(jnp.where(x >= 0, x, 0.0)), jnp.nan)
+
+
+def safe_asin(x):
+    ok = (x >= -1) & (x <= 1)
+    return jnp.where(ok, jnp.arcsin(jnp.clip(x, -1, 1)), jnp.nan)
+
+
+def safe_acos(x):
+    ok = (x >= -1) & (x <= 1)
+    return jnp.where(ok, jnp.arccos(jnp.clip(x, -1, 1)), jnp.nan)
+
+
+def safe_acosh(x):
+    return jnp.where(x >= 1, jnp.arccosh(jnp.where(x >= 1, x, 1.0)), jnp.nan)
+
+
+def safe_atanh(x):
+    ok = (x >= -1) & (x <= 1)
+    return jnp.where(ok, jnp.arctanh(jnp.clip(x, -1, 1)), jnp.nan)
+
+
+def atanh_clip(x):
+    """atanh((x + 1) % 2 - 1), always defined (src/Operators.jl:19)."""
+    return jnp.arctanh(jnp.mod(x + 1.0, 2.0) - 1.0)
+
+
+def gamma(x):
+    """Gamma function with inf->NaN (src/Operators.jl:14-17).
+
+    Computed via exp(lgamma) with the reflection sign for negative inputs.
+    """
+    sign = jnp.where(x > 0, 1.0, jnp.sign(jnp.sin(jnp.pi * x)))
+    out = sign * jnp.exp(jax.lax.lgamma(x.astype(jnp.float32)).astype(x.dtype))
+    return jnp.where(jnp.isinf(out), jnp.nan, out)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfc(x):
+    return jax.scipy.special.erfc(x)
+
+
+def square(x):
+    return x * x
+
+
+def cube(x):
+    return x * x * x
+
+
+def neg(x):
+    return -x
+
+
+def inv(x):
+    return 1.0 / x
+
+
+def relu(x):
+    return jnp.where(x > 0, x, 0.0)
+
+
+def greater(x, y):
+    return (x > y).astype(x.dtype) if hasattr(x, "dtype") else float(x > y)
+
+
+def less(x, y):
+    return (x < y).astype(x.dtype) if hasattr(x, "dtype") else float(x < y)
+
+
+def greater_equal(x, y):
+    return (x >= y).astype(x.dtype) if hasattr(x, "dtype") else float(x >= y)
+
+
+def less_equal(x, y):
+    return (x <= y).astype(x.dtype) if hasattr(x, "dtype") else float(x <= y)
+
+
+def cond(x, y):
+    """(x > 0) * y (src/Operators.jl:113-115)."""
+    return jnp.where(x > 0, y, 0.0)
+
+
+def logical_or(x, y):
+    return ((x > 0) | (y > 0)).astype(jnp.result_type(x))
+
+
+def logical_and(x, y):
+    return ((x > 0) & (y > 0)).astype(jnp.result_type(x))
+
+
+# ---------------------------------------------------------------------------
+# Operator descriptors
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Op:
+    """A single operator: a JAX-traceable elementwise function plus metadata.
+
+    `name` is the canonical (file-save) name; `pretty_name` is used for
+    terminal printing (mirrors DE.get_op_name / get_pretty_op_name,
+    /root/reference/src/Operators.jl:126-160).
+    """
+
+    name: str
+    arity: int
+    fn: Callable
+    infix: bool = False
+    pretty_name: Union[str, None] = None
+    commutative: bool = False
+
+    @property
+    def display(self) -> str:
+        return self.pretty_name if self.pretty_name is not None else self.name
+
+    def __call__(self, *args):
+        return self.fn(*args)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Op({self.name}/{self.arity})"
+
+
+def _binary(name, fn, **kw):
+    return Op(name=name, arity=2, fn=fn, **kw)
+
+
+def _unary(name, fn, **kw):
+    return Op(name=name, arity=1, fn=fn, **kw)
+
+
+_BUILTIN_OPS = [
+    # Binary
+    _binary("+", lambda x, y: x + y, infix=True, commutative=True),
+    _binary("-", lambda x, y: x - y, infix=True),
+    _binary("*", lambda x, y: x * y, infix=True, commutative=True),
+    _binary("/", lambda x, y: x / y, infix=True),
+    _binary("^", safe_pow, infix=True),
+    _binary("mod", jnp.mod),
+    _binary("max", jnp.maximum, commutative=True),
+    _binary("min", jnp.minimum, commutative=True),
+    _binary("atan2", jnp.arctan2),
+    _binary("greater", greater, pretty_name=">"),
+    _binary("less", less, pretty_name="<"),
+    _binary("greater_equal", greater_equal, pretty_name=">="),
+    _binary("less_equal", less_equal, pretty_name="<="),
+    _binary("cond", cond),
+    _binary("logical_or", logical_or),
+    _binary("logical_and", logical_and),
+    # Unary
+    _unary("exp", jnp.exp),
+    _unary("abs", jnp.abs),
+    _unary("log", safe_log),
+    _unary("log2", safe_log2),
+    _unary("log10", safe_log10),
+    _unary("log1p", safe_log1p),
+    _unary("sqrt", safe_sqrt),
+    _unary("cbrt", jnp.cbrt),
+    _unary("sin", jnp.sin),
+    _unary("cos", jnp.cos),
+    _unary("tan", jnp.tan),
+    _unary("sinh", jnp.sinh),
+    _unary("cosh", jnp.cosh),
+    _unary("tanh", jnp.tanh),
+    _unary("asin", safe_asin),
+    _unary("acos", safe_acos),
+    _unary("atan", jnp.arctan),
+    _unary("asinh", jnp.arcsinh),
+    _unary("acosh", safe_acosh),
+    _unary("atanh", safe_atanh),
+    _unary("atanh_clip", atanh_clip),
+    _unary("erf", erf),
+    _unary("erfc", erfc),
+    _unary("gamma", gamma),
+    _unary("square", square),
+    _unary("cube", cube),
+    _unary("neg", neg),
+    _unary("inv", inv),
+    _unary("relu", relu),
+    _unary("round", jnp.round),
+    _unary("floor", jnp.floor),
+    _unary("ceil", jnp.ceil),
+    _unary("sign", jnp.sign),
+]
+
+OPERATOR_REGISTRY = {op.name: op for op in _BUILTIN_OPS}
+
+# Aliases mapping "unsafe"/Julia-style names to safe versions (get_safe_op,
+# /root/reference/src/Operators.jl:171-185, plus print-name aliases).
+_ALIASES = {
+    "plus": "+",
+    "sub": "-",
+    "mult": "*",
+    "div": "/",
+    "pow": "^",
+    "safe_pow": "^",
+    "pow_abs": "^",
+    "safe_log": "log",
+    "safe_log2": "log2",
+    "safe_log10": "log10",
+    "safe_log1p": "log1p",
+    "safe_sqrt": "sqrt",
+    "safe_asin": "asin",
+    "safe_acos": "acos",
+    "safe_acosh": "acosh",
+    "safe_atanh": "atanh",
+    ">": "greater",
+    "<": "less",
+    ">=": "greater_equal",
+    "<=": "less_equal",
+    "maximum": "max",
+    "minimum": "min",
+}
+
+
+def resolve_operator(spec, arity: Union[int, None] = None) -> Op:
+    """Resolve a user operator spec (name string, Op, or callable) to an Op.
+
+    Plain callables must be JAX-traceable elementwise functions; they are
+    wrapped with the callable's ``__name__``.
+    """
+    if isinstance(spec, Op):
+        return spec
+    if isinstance(spec, str):
+        name = _ALIASES.get(spec, spec)
+        if name not in OPERATOR_REGISTRY:
+            raise ValueError(
+                f"Unknown operator {spec!r}. Register it by passing an "
+                f"`Op(name=..., arity=..., fn=...)` instead."
+            )
+        op = OPERATOR_REGISTRY[name]
+        if arity is not None and op.arity != arity:
+            raise ValueError(f"Operator {spec!r} has arity {op.arity}, expected {arity}.")
+        return op
+    if callable(spec):
+        if arity is None:
+            raise ValueError(
+                "When passing a bare callable as an operator you must place it "
+                "in the correct arity list."
+            )
+        name = getattr(spec, "__name__", None) or f"custom_{arity}ary"
+        return Op(name=name, arity=arity, fn=spec)
+    raise TypeError(f"Cannot interpret operator spec: {spec!r}")
+
+
+DEFAULT_BINARY = ("+", "-", "/", "*")  # default_options(), src/Options.jl:1163
+DEFAULT_UNARY = ()
+
+
+class OperatorSet:
+    """Operators grouped by arity — the `OperatorEnum` equivalent.
+
+    ``ops[d]`` is the tuple of operators of arity ``d`` (1-based, matching
+    `operators.ops[degree]` in the reference). ``nops`` gives per-arity
+    counts used by mutation sampling.
+    """
+
+    def __init__(
+        self,
+        binary_operators: Sequence = DEFAULT_BINARY,
+        unary_operators: Sequence = DEFAULT_UNARY,
+        *,
+        ops_by_arity: Union[dict, None] = None,
+    ):
+        if ops_by_arity is None:
+            ops_by_arity = {
+                1: tuple(resolve_operator(o, 1) for o in unary_operators),
+                2: tuple(resolve_operator(o, 2) for o in binary_operators),
+            }
+        self._ops = {d: tuple(ops) for d, ops in sorted(ops_by_arity.items())}
+        self.max_arity = max([d for d, ops in self._ops.items() if ops], default=2)
+        # Flat index tables for the tensorized interpreter.
+        for d, ops in self._ops.items():
+            for op in ops:
+                if op.arity != d:
+                    raise ValueError(f"{op} placed in arity-{d} slot")
+
+    @property
+    def ops(self):
+        return self._ops
+
+    def __getitem__(self, arity: int):
+        return self._ops.get(arity, ())
+
+    @property
+    def unary(self):
+        return self._ops.get(1, ())
+
+    @property
+    def binary(self):
+        return self._ops.get(2, ())
+
+    @property
+    def nops(self):
+        return {d: len(ops) for d, ops in self._ops.items()}
+
+    def nops_tuple(self, max_arity: Union[int, None] = None):
+        ma = max_arity or self.max_arity
+        return tuple(len(self._ops.get(d, ())) for d in range(1, ma + 1))
+
+    def index_of(self, spec, arity: Union[int, None] = None):
+        """Return (arity, index) of an operator within this set."""
+        if isinstance(spec, (Op, str)):
+            target = resolve_operator(spec, arity)
+            target_name = target.name
+        elif callable(spec):
+            target = spec
+            target_name = getattr(spec, "__name__", None)
+        else:
+            raise TypeError(f"Cannot look up operator {spec!r}")
+        for d, ops in self._ops.items():
+            for i, op in enumerate(ops):
+                if op is target or op.fn is target or op.name == target_name:
+                    return d, i
+        raise KeyError(f"Operator {spec!r} not in OperatorSet")
+
+    def _key(self):
+        # Two same-named ops with different fns must not collide in jit
+        # caches keyed on this set.
+        return tuple(
+            (d, tuple((o.name, id(o.fn)) for o in ops)) for d, ops in self._ops.items()
+        )
+
+    def __eq__(self, other):
+        if not isinstance(other, OperatorSet):
+            return NotImplemented
+        return self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self) -> str:  # pragma: no cover
+        parts = []
+        for d, ops in self._ops.items():
+            parts.append(f"{d}: [" + ", ".join(o.name for o in ops) + "]")
+        return "OperatorSet(" + "; ".join(parts) + ")"

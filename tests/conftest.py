@@ -1,0 +1,19 @@
+"""Test configuration: force CPU JAX with 8 virtual devices.
+
+Multi-chip sharding is tested on a virtual CPU mesh
+(xla_force_host_platform_device_count), standing in for real TPU chips as
+in SURVEY.md §4's implication notes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("SYMBOLIC_REGRESSION_IS_TESTING", "true")
+# Persistent compilation cache makes repeated test runs much faster.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")

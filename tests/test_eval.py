@@ -1,0 +1,182 @@
+"""Interpreter golden tests: tensorized eval vs host-side evaluation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.core.losses import aggregate_loss, loss_to_cost, resolve_loss
+from symbolicregression_jl_tpu.ops.encoding import encode_population
+from symbolicregression_jl_tpu.ops.eval import eval_tree_batch
+from symbolicregression_jl_tpu.ops.operators import OperatorSet
+from symbolicregression_jl_tpu.ops.tree import parse_expression
+
+OPS = OperatorSet(binary_operators=["+", "-", "*", "/", "^"],
+                  unary_operators=["sin", "cos", "exp", "log", "sqrt", "abs"])
+
+
+def host_eval(tree, X):
+    # X: [n, F]
+    return np.array([tree.eval_scalar(row) for row in X])
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 5)).astype(np.float32)
+    return X
+
+
+EXPRS = [
+    "x1 + x2",
+    "3.5",
+    "x4",
+    "sin(x1) * cos(x2)",
+    "exp(x1 / 2.0) - x3 * x4",
+    "abs(x2) ^ 0.9",
+    "(x1 + x2) * (x3 - 1.5) / (x5 + 10.0)",
+    "sqrt(abs(x1)) + log(abs(x2) + 1.0)",
+]
+
+
+def test_eval_matches_host(data):
+    X = data
+    trees = [parse_expression(e, OPS) for e in EXPRS]
+    batch = encode_population(trees, 31, OPS)
+    y, valid = eval_tree_batch(batch, jnp.asarray(X.T), OPS)
+    y = np.asarray(y)
+    for i, t in enumerate(trees):
+        expected = host_eval(t, X)
+        assert np.asarray(valid)[i], EXPRS[i]
+        np.testing.assert_allclose(y[i], expected, rtol=2e-5, atol=1e-5, err_msg=EXPRS[i])
+
+
+def test_invalid_detection(data):
+    X = data  # contains negatives
+    trees = [
+        parse_expression("log(x1)", OPS),     # invalid (negative args)
+        parse_expression("sqrt(x2)", OPS),    # invalid
+        parse_expression("x1 / 0.0", OPS),    # inf -> invalid
+        parse_expression("x1 + 1.0", OPS),    # valid
+    ]
+    batch = encode_population(trees, 15, OPS)
+    _, valid = eval_tree_batch(batch, jnp.asarray(X.T), OPS)
+    assert list(np.asarray(valid)) == [False, False, False, True]
+
+
+def test_intermediate_inf_is_invalid():
+    # exp overflows to inf at an intermediate node, then 1/inf = 0 would be
+    # finite — the reference's early-exit still flags it.
+    X = np.full((4, 1), 200.0, np.float32)
+    t = parse_expression("1.0 / exp(x1)", OPS)
+    batch = encode_population([t], 15, OPS)
+    _, valid = eval_tree_batch(batch, jnp.asarray(X.T), OPS)
+    assert not bool(np.asarray(valid)[0])
+
+
+def test_batched_shapes(data):
+    X = jnp.asarray(data.T)
+    trees = [parse_expression(e, OPS) for e in EXPRS[:6]]
+    batch = encode_population(trees, 31, OPS).reshape(2, 3)
+    y, valid = eval_tree_batch(batch, X, OPS)
+    assert y.shape == (2, 3, 64)
+    assert valid.shape == (2, 3)
+
+
+def test_grad_through_interpreter(data):
+    """jax.grad wrt constants matches finite differences."""
+    X = jnp.asarray(data.T)
+    t = parse_expression("x1 * 2.0 + sin(x2) * 0.5", OPS)
+    batch = encode_population([t], 15, OPS)
+    y_target = jnp.asarray(host_eval(parse_expression("x1 * 1.7 + sin(x2) * 0.9", OPS), data))
+    loss_fn_el = resolve_loss(None)
+
+    def loss_of_consts(const):
+        import dataclasses
+
+        b = dataclasses.replace(batch, const=const)
+        pred, valid = eval_tree_batch(b, X, OPS)
+        return aggregate_loss(loss_fn_el, pred[0], y_target, valid[0])
+
+    g = jax.grad(loss_of_consts)(batch.const)
+    g = np.asarray(g)[0]
+    # finite differences on the two used constant slots
+    const0 = np.asarray(batch.const)[0]
+    used = [i for i in range(15) if const0[i] != 0.0]
+    eps = 1e-3
+    for i in used:
+        cp = const0.copy(); cp[i] += eps
+        cm = const0.copy(); cm[i] -= eps
+        fp = float(loss_of_consts(jnp.asarray(cp)[None]))
+        fm = float(loss_of_consts(jnp.asarray(cm)[None]))
+        fd = (fp - fm) / (2 * eps)
+        assert g[i] == pytest.approx(fd, rel=1e-2, abs=1e-3)
+
+
+class TestLosses:
+    def test_weighted(self):
+        pred = jnp.asarray([1.0, 2.0, 3.0])
+        y = jnp.asarray([0.0, 0.0, 0.0])
+        w = jnp.asarray([1.0, 1.0, 2.0])
+        loss = aggregate_loss(resolve_loss("L2DistLoss"), pred, y, jnp.bool_(True), w)
+        assert float(loss) == pytest.approx((1 + 4 + 2 * 9) / 4)
+
+    def test_invalid_inf(self):
+        pred = jnp.asarray([1.0, jnp.nan])
+        y = jnp.zeros(2)
+        loss = aggregate_loss(resolve_loss(None), pred, y, jnp.bool_(False))
+        assert np.isinf(float(loss))
+
+    def test_loss_to_cost(self):
+        cost = loss_to_cost(
+            jnp.asarray(2.0), jnp.asarray(4.0), jnp.bool_(True),
+            jnp.asarray(10, jnp.int32), 0.01,
+        )
+        assert float(cost) == pytest.approx(0.5 + 0.1)
+
+    def test_loss_to_cost_floor(self):
+        cost = loss_to_cost(
+            jnp.asarray(2.0), jnp.asarray(0.001), jnp.bool_(True),
+            jnp.asarray(0, jnp.int32), 0.0,
+        )
+        assert float(cost) == pytest.approx(200.0)
+
+
+def test_complexity_and_constraints():
+    from symbolicregression_jl_tpu.core.options import Options
+    from symbolicregression_jl_tpu.ops.complexity import (
+        build_complexity_tables,
+        check_constraints_batch,
+        compute_complexity_batch,
+    )
+    from symbolicregression_jl_tpu.ops.encoding import tree_structure_arrays
+
+    opts = Options(
+        binary_operators=["+", "*", "^"],
+        unary_operators=["sin", "exp"],
+        maxsize=10,
+        maxdepth=4,
+        constraints={"^": (-1, 2)},
+        nested_constraints={"sin": {"sin": 0}},
+        complexity_of_operators={"exp": 3},
+    )
+    tables = build_complexity_tables(opts, 5)
+    trees = [
+        parse_expression("x1 + x2", opts.operators),            # cx 3, ok
+        parse_expression("exp(x1)", opts.operators),            # cx 1+3=4, ok
+        parse_expression("x1 ^ (x2 + x3)", opts.operators),     # ^ arg2 size 3 > 2 -> bad
+        parse_expression("sin(sin(x1))", opts.operators),       # nested sin -> bad
+        parse_expression("sin(x1 * sin(x2)) + sin(x3)", opts.operators),  # nested -> bad
+        parse_expression("sin(x1) + sin(x2)", opts.operators),  # ok
+        parse_expression("x1 * x2 * x3 * x4 * x5 * x1", opts.operators),  # cx 11 > 10 -> bad
+        parse_expression("((x1 + x2) + x3) + ((x4 + x5) + (x1 + x2))", opts.operators),  # 13 nodes > 10 -> bad
+    ]
+    batch = encode_population(trees, 16, opts.operators)
+    cx = np.asarray(compute_complexity_batch(batch, tables))
+    assert cx[0] == 3
+    assert cx[1] == 4
+    child, size, depth = tree_structure_arrays(batch)
+    ok = np.asarray(
+        check_constraints_batch(batch, opts, tables, jnp.asarray(10), child, size, depth)
+    )
+    assert list(ok) == [True, True, False, False, False, True, False, False]

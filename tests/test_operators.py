@@ -1,0 +1,106 @@
+"""Safe-operator NaN semantics (parity with src/Operators.jl:35-124)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.ops import operators as ops
+
+
+def arr(*vals):
+    return jnp.asarray(vals, jnp.float32)
+
+
+class TestSafePow:
+    def test_positive_base(self):
+        out = ops.safe_pow(arr(2.0), arr(3.0))
+        assert np.allclose(out, 8.0)
+
+    def test_negative_base_integer_exponent(self):
+        assert np.allclose(ops.safe_pow(arr(-2.0), arr(3.0)), -8.0)
+        assert np.allclose(ops.safe_pow(arr(-2.0), arr(2.0)), 4.0)
+
+    def test_negative_base_noninteger_exponent_nan(self):
+        assert np.isnan(ops.safe_pow(arr(-2.0), arr(0.5)))
+
+    def test_zero_base_negative_integer_exponent_nan(self):
+        assert np.isnan(ops.safe_pow(arr(0.0), arr(-2.0)))
+
+    def test_zero_base_negative_noninteger_exponent_nan(self):
+        assert np.isnan(ops.safe_pow(arr(0.0), arr(-0.5)))
+
+    def test_negative_base_negative_noninteger_nan(self):
+        assert np.isnan(ops.safe_pow(arr(-1.0), arr(-0.5)))
+
+    def test_zero_zero_is_one(self):
+        assert np.allclose(ops.safe_pow(arr(0.0), arr(0.0)), 1.0)
+
+    def test_negative_integer_exponent(self):
+        assert np.allclose(ops.safe_pow(arr(-2.0), arr(-2.0)), 0.25)
+        assert np.allclose(ops.safe_pow(arr(-2.0), arr(-3.0)), -0.125)
+
+
+@pytest.mark.parametrize(
+    "fn,good,good_val,bad",
+    [
+        (ops.safe_log, 1.0, 0.0, -1.0),
+        (ops.safe_log, np.e, 1.0, 0.0),
+        (ops.safe_log2, 8.0, 3.0, -2.0),
+        (ops.safe_log10, 100.0, 2.0, 0.0),
+        (ops.safe_log1p, 0.0, 0.0, -1.5),
+        (ops.safe_sqrt, 4.0, 2.0, -1.0),
+        (ops.safe_asin, 1.0, np.pi / 2, 1.5),
+        (ops.safe_acos, 1.0, 0.0, -1.5),
+        (ops.safe_acosh, 1.0, 0.0, 0.5),
+        (ops.safe_atanh, 0.0, 0.0, 1.5),
+    ],
+)
+def test_safe_unary_domains(fn, good, good_val, bad):
+    assert np.allclose(fn(arr(good)), good_val, atol=1e-6)
+    assert np.isnan(fn(arr(bad)))
+
+
+def test_comparison_ops_return_float():
+    assert float(ops.greater(arr(2.0), arr(1.0))[0]) == 1.0
+    assert float(ops.less(arr(2.0), arr(1.0))[0]) == 0.0
+    assert float(ops.cond(arr(1.0), arr(5.0))[0]) == 5.0
+    assert float(ops.cond(arr(-1.0), arr(5.0))[0]) == 0.0
+    assert float(ops.logical_or(arr(-1.0), arr(2.0))[0]) == 1.0
+    assert float(ops.logical_and(arr(-1.0), arr(2.0))[0]) == 0.0
+
+
+def test_gamma_matches_scipy_and_poles():
+    from math import gamma as pygamma
+
+    for x in (0.5, 1.0, 2.5, 4.0, -0.5, -1.5):
+        got = float(ops.gamma(jnp.asarray([x], jnp.float32))[0])
+        assert got == pytest.approx(pygamma(x), rel=2e-4), x
+    assert np.isnan(ops.gamma(arr(0.0)))  # pole -> inf -> NaN
+
+
+def test_operator_set_basics():
+    s = ops.OperatorSet(binary_operators=["+", "-", "*", "/"],
+                        unary_operators=["sin", "exp"])
+    assert s.nops == {1: 2, 2: 4}
+    assert s.nops_tuple() == (2, 4)
+    d, i = s.index_of("sin")
+    assert (d, i) == (1, 0)
+    assert s == ops.OperatorSet(binary_operators=("+", "-", "*", "/"),
+                                unary_operators=("sin", "exp"))
+
+
+def test_alias_resolution():
+    assert ops.resolve_operator("plus").name == "+"
+    assert ops.resolve_operator("safe_log").name == "log"
+    assert ops.resolve_operator("pow").name == "^"
+
+
+def test_custom_callable_operator():
+    import jax.numpy as jnp
+
+    def myop(x, y):
+        return x * y + 1
+
+    op = ops.resolve_operator(myop, 2)
+    assert op.arity == 2
+    assert np.allclose(op.fn(jnp.asarray(2.0), jnp.asarray(3.0)), 7.0)

@@ -1,0 +1,159 @@
+"""Host tree parse/print/simplify + postfix encode/decode round trips."""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.ops.encoding import (
+    TreeBatch,
+    decode_tree,
+    encode_population,
+    encode_tree,
+    tree_structure_arrays,
+)
+from symbolicregression_jl_tpu.ops.operators import OperatorSet
+from symbolicregression_jl_tpu.ops.tree import (
+    Node,
+    combine_operators,
+    parse_expression,
+    simplify_tree,
+    string_tree,
+)
+
+OPS = OperatorSet(binary_operators=["+", "-", "*", "/", "^"],
+                  unary_operators=["sin", "cos", "exp", "log"])
+
+
+class TestParsePrint:
+    @pytest.mark.parametrize(
+        "expr",
+        [
+            "x1 + x2",
+            "x1 * x2 + 3.0",
+            "sin(x1)",
+            "(x1 + x2) * x3",
+            "x1 - x2 - x3",
+            "x1 / (x2 + 1.5)",
+            "sin(cos(x1 + 2.0)) * 3.5",
+            "x1 ^ 2.0",
+            "exp(log(x1))",
+        ],
+    )
+    def test_roundtrip(self, expr):
+        t = parse_expression(expr, OPS)
+        s = string_tree(t)
+        t2 = parse_expression(s, OPS)
+        assert t == t2, (expr, s)
+
+    def test_unary_minus(self):
+        t = parse_expression("-x1 + 2.0", OPS)
+        assert t.count_nodes() == 4  # neg(x1) + 2.0
+
+    def test_negative_constant(self):
+        t = parse_expression("-2.5 * x1", OPS)
+        consts = t.get_scalar_constants()
+        assert consts == [-2.5]
+
+    def test_variable_names(self):
+        t = parse_expression("alpha + beta", OPS, variable_names=["alpha", "beta"])
+        assert string_tree(t, ["alpha", "beta"]) == "alpha + beta"
+        assert t.children[0].feature == 0
+        assert t.children[1].feature == 1
+
+    def test_precedence_printing(self):
+        t = parse_expression("(x1 + x2) * x3", OPS)
+        assert string_tree(t) == "(x1 + x2) * x3"
+        t = parse_expression("x1 + x2 * x3", OPS)
+        assert string_tree(t) == "x1 + x2 * x3"
+
+    def test_eval_scalar(self):
+        t = parse_expression("sin(x1) + x2 * 2.0", OPS)
+        got = t.eval_scalar([0.5, 3.0])
+        assert got == pytest.approx(np.sin(0.5) + 6.0)
+
+    def test_scalar_constants_api(self):
+        t = parse_expression("x1 * 2.0 + 3.0", OPS)
+        assert t.get_scalar_constants() == [2.0, 3.0]
+        t.set_scalar_constants([5.0, 7.0])
+        assert string_tree(t) == "x1 * 5.0 + 7.0"
+
+
+class TestSimplify:
+    def test_constant_folding(self):
+        t = parse_expression("x1 + (2.0 + 3.0)", OPS)
+        s = simplify_tree(t, OPS)
+        assert string_tree(s) == "x1 + 5.0"
+
+    def test_fold_nested(self):
+        t = parse_expression("sin(2.0 * 3.0) + x1", OPS)
+        s = simplify_tree(t, OPS)
+        assert s.children[0].constant
+        assert s.children[0].val == pytest.approx(np.sin(6.0))
+
+    def test_combine_operators(self):
+        t = parse_expression("(x1 + 1.5) + 2.5", OPS)
+        c = combine_operators(simplify_tree(t))
+        assert string_tree(c) == "x1 + 4.0"
+
+    def test_combine_mult(self):
+        t = parse_expression("(x1 * 2.0) * 3.0", OPS)
+        c = combine_operators(simplify_tree(t))
+        assert string_tree(c) == "x1 * 6.0"
+
+    def test_combine_sub(self):
+        t = parse_expression("(x1 - 1.0) - 2.0", OPS)
+        c = combine_operators(simplify_tree(t))
+        assert string_tree(c) == "x1 - 3.0"
+
+
+class TestEncoding:
+    @pytest.mark.parametrize(
+        "expr",
+        [
+            "1.5",
+            "x3",
+            "x1 + x2",
+            "sin(x1) * (x2 - 0.5)",
+            "exp(x1 / x2) + cos(x3 ^ 2.0)",
+            "((x1 + x2) * (x3 + x4)) / (x5 - 1.0)",
+        ],
+    )
+    def test_roundtrip(self, expr):
+        t = parse_expression(expr, OPS)
+        enc = encode_tree(t, 31, OPS)
+        t2 = decode_tree(*enc, OPS)
+        assert t == t2
+
+    def test_length(self):
+        t = parse_expression("sin(x1) + 2.0", OPS)
+        arity, op, feat, const, length = encode_tree(t, 31, OPS)
+        assert int(length) == 4
+        # postfix: x1, sin, 2.0, +
+        assert list(arity[:4]) == [0, 1, 0, 2]
+
+    def test_too_large_raises(self):
+        t = parse_expression("x1 + x2 + x3 + x4", OPS)
+        with pytest.raises(ValueError):
+            encode_tree(t, 4, OPS)
+
+    def test_structure_arrays(self):
+        t = parse_expression("sin(x1) + (x2 * 3.0)", OPS)
+        batch = encode_population([t], 16, OPS)
+        child, size, depth = tree_structure_arrays(batch)
+        child, size, depth = np.asarray(child)[0], np.asarray(size)[0], np.asarray(depth)[0]
+        n = int(batch.length[0])
+        assert n == 6  # x1 sin x2 3.0 * +
+        # root is slot 5: children sin@1 and *@4
+        assert list(child[5][:2]) == [1, 4]
+        assert size[5] == 6
+        assert size[1] == 2  # sin(x1)
+        assert size[4] == 3  # x2*3
+        assert depth[5] == 3
+
+    def test_population_roundtrip(self):
+        from symbolicregression_jl_tpu.ops.encoding import decode_population
+
+        exprs = ["x1 + 1.0", "sin(x2)", "x1 * x2 / x3"]
+        trees = [parse_expression(e, OPS) for e in exprs]
+        batch = encode_population(trees, 16, OPS)
+        back = decode_population(batch, OPS)
+        assert all(a == b for a, b in zip(trees, back))
