@@ -1,0 +1,210 @@
+"""Host-side hall of fame: pareto frontier, scores, formatting, CSV IO.
+
+TPU analogue of /root/reference/src/HallOfFame.jl. The device-resident
+`HofState` (best member per complexity level, evolve/step.py) is decoded
+into host `Node` trees here for reporting, selection, and persistence —
+these paths never sit in the generation hot loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.encoding import decode_tree
+from ..ops.operators import OperatorSet
+from ..ops.tree import Node, parse_expression, string_tree
+
+__all__ = [
+    "HallOfFameEntry",
+    "HallOfFame",
+    "calculate_pareto_frontier",
+    "compute_scores",
+    "string_dominating_pareto_curve",
+    "save_hall_of_fame_csv",
+    "load_hall_of_fame_csv",
+]
+
+
+@dataclasses.dataclass
+class HallOfFameEntry:
+    """One best-at-complexity member (PopMember analogue on host)."""
+
+    tree: Node
+    loss: float
+    cost: float
+    complexity: int
+    score: float = 0.0
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"HallOfFameEntry(complexity={self.complexity}, "
+            f"loss={self.loss:.6g})"
+        )
+
+
+@dataclasses.dataclass
+class HallOfFame:
+    """Best member per complexity level (src/HallOfFame.jl:26-29)."""
+
+    entries: List[HallOfFameEntry]
+
+    @staticmethod
+    def from_device(hof_state, operators: OperatorSet) -> "HallOfFame":
+        """Decode a device HofState into host entries (existing only)."""
+        exists = np.asarray(hof_state.exists)
+        cost = np.asarray(hof_state.cost)
+        loss = np.asarray(hof_state.loss)
+        complexity = np.asarray(hof_state.complexity)
+        arity = np.asarray(hof_state.trees.arity)
+        op = np.asarray(hof_state.trees.op)
+        feat = np.asarray(hof_state.trees.feat)
+        const = np.asarray(hof_state.trees.const)
+        length = np.asarray(hof_state.trees.length)
+        entries = []
+        for i in range(exists.shape[0]):
+            if not exists[i]:
+                continue
+            tree = decode_tree(
+                arity[i], op[i], feat[i], const[i], length[i], operators
+            )
+            entries.append(
+                HallOfFameEntry(
+                    tree=tree,
+                    loss=float(loss[i]),
+                    cost=float(cost[i]),
+                    complexity=int(complexity[i]),
+                )
+            )
+        entries.sort(key=lambda e: e.complexity)
+        return HallOfFame(entries=entries)
+
+    def pareto_frontier(self) -> List[HallOfFameEntry]:
+        return calculate_pareto_frontier(self.entries)
+
+
+def calculate_pareto_frontier(
+    entries: Sequence[HallOfFameEntry],
+) -> List[HallOfFameEntry]:
+    """Members whose loss beats every simpler member
+    (src/HallOfFame.jl:96-124: dominating iff loss < all lower-complexity
+    losses)."""
+    frontier: List[HallOfFameEntry] = []
+    best = np.inf
+    for e in sorted(entries, key=lambda e: e.complexity):
+        if np.isfinite(e.loss) and e.loss < best:
+            frontier.append(e)
+            best = e.loss
+    return frontier
+
+
+def compute_scores(
+    frontier: Sequence[HallOfFameEntry], loss_scale: str = "log"
+) -> List[HallOfFameEntry]:
+    """Attach score = -Δlog(loss)/Δcomplexity (log scale) or the direct
+    negative slope (linear scale), vs. the previous frontier member
+    (format_hall_of_fame, src/HallOfFame.jl:217-266)."""
+    ZERO_POINT = 1e-12
+    out = []
+    prev_loss = None
+    prev_c = None
+    for e in frontier:
+        if prev_loss is None:
+            score = 0.0
+        else:
+            dc = max(e.complexity - prev_c, 1)
+            if loss_scale == "log":
+                cur = max(e.loss, ZERO_POINT)
+                prev = max(prev_loss, ZERO_POINT)
+                score = -(np.log(cur) - np.log(prev)) / dc
+            else:
+                score = -(e.loss - prev_loss) / dc
+        out.append(dataclasses.replace(e, score=float(score)))
+        prev_loss, prev_c = e.loss, e.complexity
+    return out
+
+
+def string_dominating_pareto_curve(
+    hof: HallOfFame,
+    operators: OperatorSet,
+    variable_names: Optional[Sequence[str]] = None,
+    loss_scale: str = "log",
+    precision: int = 5,
+    width: int = 100,
+) -> str:
+    """Terminal table of the dominating pareto frontier
+    (src/HallOfFame.jl:138-215)."""
+    frontier = compute_scores(hof.pareto_frontier(), loss_scale)
+    sep = "─" * width
+    lines = ["┌" + sep + "┐"]
+    header = f"{'Complexity':<12}{'Loss':<12}{'Score':<12}Equation"
+    lines.append("│ " + header.ljust(width - 2) + " │")
+    for e in frontier:
+        eq = string_tree(
+            e.tree, variable_names=variable_names, precision=precision
+        )
+        row = (
+            f"{e.complexity:<12d}{e.loss:<12.4g}{e.score:<12.4g}{eq}"
+        )
+        # wrap long equations
+        while len(row) > width - 4:
+            lines.append("│ " + row[: width - 4].ljust(width - 2) + " │")
+            row = " " * 36 + row[width - 4 :]
+        lines.append("│ " + row.ljust(width - 2) + " │")
+    lines.append("└" + sep + "┘")
+    return "\n".join(lines)
+
+
+def save_hall_of_fame_csv(
+    path: str,
+    hof: HallOfFame,
+    operators: OperatorSet,
+    variable_names: Optional[Sequence[str]] = None,
+    precision: int = 12,
+) -> None:
+    """Write `Complexity,Loss,Equation` CSV with `.bak` double-write
+    (save_to_file, src/SearchUtils.jl:605-649): write the backup first,
+    then atomically move it over the target so a crash mid-write never
+    corrupts the existing file."""
+    rows = ["Complexity,Loss,Equation"]
+    for e in hof.entries:
+        eq = string_tree(
+            e.tree, variable_names=variable_names, precision=precision
+        )
+        rows.append(f'{e.complexity},{e.loss!r},"{eq}"')
+    body = "\n".join(rows) + "\n"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    bak = path + ".bak"
+    with open(bak, "w") as f:
+        f.write(body)
+    os.replace(bak, path)
+
+
+def load_hall_of_fame_csv(
+    path: str,
+    operators: OperatorSet,
+    variable_names: Optional[Sequence[str]] = None,
+) -> List[Node]:
+    """Parse a saved hall-of-fame CSV back into trees (warm start path,
+    load_saved_hall_of_fame, src/SearchUtils.jl:532-545)."""
+    trees: List[Node] = []
+    with open(path) as f:
+        header = f.readline()
+        if not header.startswith("Complexity"):
+            raise ValueError(f"Not a hall-of-fame CSV: {path}")
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split(",", 2)
+            eq = parts[2].strip()
+            if eq.startswith('"') and eq.endswith('"'):
+                eq = eq[1:-1]
+            trees.append(
+                parse_expression(eq, operators, variable_names=variable_names)
+            )
+    return trees

@@ -1,0 +1,327 @@
+"""Sklearn-style regressor API (TPU analogue of src/MLJInterface.jl).
+
+`SRRegressor` mirrors every `Options` kwarg as a constructor kwarg
+(the reference auto-generates its model struct the same way,
+/root/reference/src/MLJInterface.jl:68-126), runs `equation_search` on
+`fit`, supports warm-start refits that run only the *delta* iterations
+(/root/reference/src/MLJInterface.jl:292-294), and predicts with the
+`choose_best` selection rule (:611-630) or a user-chosen equation index.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.options import Options
+from ..ops.encoding import encode_population
+from ..ops.eval import eval_tree_batch
+from ..ops.tree import Node, string_tree
+from .hall_of_fame import HallOfFame, calculate_pareto_frontier, compute_scores
+from .search import RuntimeOptions, SearchState, equation_search
+
+__all__ = ["SRRegressor", "MultitargetSRRegressor", "choose_best"]
+
+
+def choose_best(
+    *, trees, losses, scores, complexities, options: Optional[Options] = None
+) -> int:
+    """Max score among equations with loss below 1.5x the minimum loss
+    (src/MLJInterface.jl:611-630; same as PySR's model_selection='best').
+    Linear loss_scale falls back to plain argmin(loss)."""
+    losses = np.asarray(losses, dtype=float)
+    if options is not None and options.loss_scale == "linear":
+        return int(np.argmin(losses))
+    threshold = 1.5 * np.min(losses)
+    masked_scores = [
+        s if l <= threshold else -np.inf for s, l in zip(scores, losses)
+    ]
+    return int(np.argmax(masked_scores))
+
+
+@dataclasses.dataclass
+class EquationRecord:
+    """One row of the fitted report (equations_ table)."""
+
+    complexity: int
+    loss: float
+    score: float
+    equation: str
+    tree: Node
+
+
+class SRRegressor:
+    """Symbolic-regression estimator with the sklearn fit/predict contract.
+
+    Examples
+    --------
+    >>> model = SRRegressor(niterations=5, binary_operators=["+", "*"])
+    >>> model.fit(X, y)
+    >>> model.predict(X)
+    """
+
+    _MULTITARGET = False
+
+    def __init__(
+        self,
+        *,
+        niterations: int = 40,
+        selection_method: Callable = choose_best,
+        seed: Optional[int] = None,
+        verbosity: int = 0,
+        progress: bool = False,
+        run_id: Optional[str] = None,
+        warm_start: bool = True,
+        devices=None,
+        n_data_shards: int = 1,
+        **option_kwargs: Any,
+    ):
+        self.niterations = int(niterations)
+        self.selection_method = selection_method
+        self.seed = seed
+        self.verbosity = verbosity
+        self.progress = progress
+        self.run_id = run_id
+        self.warm_start = bool(warm_start)
+        self.devices = devices
+        self.n_data_shards = int(n_data_shards)
+        self.option_kwargs = dict(option_kwargs)
+
+        # Fitted state:
+        self.options_: Optional[Options] = None
+        self.state_: Optional[SearchState] = None
+        self.hofs_: Optional[List[HallOfFame]] = None
+        self.equations_: Optional[Any] = None
+        self.best_idx_: Optional[Any] = None
+        self.nout_: int = 1
+        self.nfeatures_: Optional[int] = None
+        self.variable_names_: Optional[Sequence[str]] = None
+        self.fitted_iterations_: int = 0
+
+    # ------------------------------------------------------------------
+    def _make_options(self) -> Options:
+        return Options(seed=self.seed, **self.option_kwargs)
+
+    def fit(
+        self,
+        X,
+        y,
+        *,
+        weights=None,
+        variable_names: Optional[Sequence[str]] = None,
+        X_units=None,
+        y_units=None,
+        category=None,
+    ) -> "SRRegressor":
+        X = np.asarray(X)
+        y = np.asarray(y)
+        if self._MULTITARGET:
+            if y.ndim != 2:
+                raise ValueError("MultitargetSRRegressor requires 2D y")
+            # sklearn convention (n, nout) -> internal (nout, n)
+            y_internal = y.T
+            self.nout_ = y_internal.shape[0]
+        else:
+            if y.ndim != 1:
+                raise ValueError("SRRegressor requires 1D y; use Multitarget")
+            y_internal = y
+            self.nout_ = 1
+
+        new_options = self._make_options()
+        saved_state = None
+        if self.warm_start and self.state_ is not None:
+            issues = new_options.check_warm_start_compatibility(self.options_)
+            if issues:
+                raise ValueError(
+                    "Warm-start refit with changed incompatible options: "
+                    f"{issues}. Pass warm_start=False or reset the model."
+                )
+            saved_state = self.state_
+        self.options_ = new_options
+        self.nfeatures_ = X.shape[1]
+        self.variable_names_ = (
+            list(variable_names)
+            if variable_names is not None
+            else [f"x{i + 1}" for i in range(X.shape[1])]
+        )
+
+        extra = None
+        if category is not None:
+            extra = {"class": np.asarray(category)}
+
+        state, hof = equation_search(
+            X,
+            y_internal,
+            options=new_options,
+            niterations=self.niterations,
+            weights=weights,
+            variable_names=variable_names,
+            X_units=X_units,
+            y_units=y_units,
+            extra=extra,
+            saved_state=saved_state,
+            verbosity=self.verbosity,
+            progress=self.progress,
+            run_id=self.run_id,
+            seed=self.seed,
+            return_state=True,
+        )
+        self.state_ = state
+        self.hofs_ = hof if isinstance(hof, list) else [hof]
+        self.fitted_iterations_ += self.niterations
+        self._build_report()
+        return self
+
+    # ------------------------------------------------------------------
+    def _build_report(self) -> None:
+        tables: List[List[EquationRecord]] = []
+        best_idx: List[int] = []
+        for hof in self.hofs_:
+            frontier = compute_scores(
+                calculate_pareto_frontier(hof.entries), self.options_.loss_scale
+            )
+            recs = [
+                EquationRecord(
+                    complexity=e.complexity,
+                    loss=e.loss,
+                    score=e.score,
+                    equation=string_tree(
+                        e.tree, variable_names=self.variable_names_
+                    ),
+                    tree=e.tree,
+                )
+                for e in frontier
+            ]
+            tables.append(recs)
+            if recs:
+                best_idx.append(
+                    self.selection_method(
+                        trees=[r.tree for r in recs],
+                        losses=[r.loss for r in recs],
+                        scores=[r.score for r in recs],
+                        complexities=[r.complexity for r in recs],
+                        options=self.options_,
+                    )
+                )
+            else:
+                best_idx.append(0)
+        if self._MULTITARGET:
+            self.equations_ = tables
+            self.best_idx_ = best_idx
+        else:
+            self.equations_ = tables[0]
+            self.best_idx_ = best_idx[0]
+
+    def _check_fitted(self) -> None:
+        if self.equations_ is None:
+            raise RuntimeError("This SRRegressor instance is not fitted yet.")
+
+    # ------------------------------------------------------------------
+    def _predict_one(self, recs, idx, X) -> np.ndarray:
+        import jax.numpy as jnp
+
+        tree = recs[idx].tree
+        enc = encode_population(
+            [tree], max(tree.count_nodes(), 1), self.options_.operators
+        )
+        y, valid = eval_tree_batch(
+            enc, jnp.asarray(X.T), self.options_.operators
+        )
+        out = np.asarray(y[0])
+        if not bool(valid[0]):
+            # prediction_fallback: zeros on invalid eval
+            # (src/MLJInterface.jl:431-456)
+            out = np.zeros(X.shape[0], out.dtype)
+        return out
+
+    def predict(self, X, idx: Optional[Union[int, Sequence[int]]] = None):
+        """Predict with the selected (or ``idx``-chosen) equation."""
+        self._check_fitted()
+        X = np.asarray(X)
+        if self._MULTITARGET:
+            idxs = (
+                list(idx)
+                if idx is not None
+                else list(self.best_idx_)
+            )
+            outs = [
+                self._predict_one(recs, i, X)
+                for recs, i in zip(self.equations_, idxs)
+            ]
+            return np.stack(outs, axis=1)
+        i = int(idx) if idx is not None else int(self.best_idx_)
+        return self._predict_one(self.equations_, i, X)
+
+    def score(self, X, y, *, sample_weight=None) -> float:
+        """Coefficient of determination R^2 (sklearn convention)."""
+        self._check_fitted()
+        y = np.asarray(y)
+        pred = self.predict(X)
+        if self._MULTITARGET:
+            pred = pred.reshape(y.shape)
+        w = (
+            np.ones_like(y, dtype=float)
+            if sample_weight is None
+            else np.asarray(sample_weight, dtype=float)
+        )
+        ss_res = float(np.sum(w * (y - pred) ** 2))
+        ss_tot = float(np.sum(w * (y - np.average(y, weights=w)) ** 2))
+        if ss_tot == 0:
+            return 0.0 if ss_res > 0 else 1.0
+        return 1.0 - ss_res / ss_tot
+
+    # ------------------------------------------------------------------
+    def get_best(self):
+        """The selected equation record (report row)."""
+        self._check_fitted()
+        if self._MULTITARGET:
+            return [
+                recs[i] for recs, i in zip(self.equations_, self.best_idx_)
+            ]
+        return self.equations_[self.best_idx_]
+
+    def latex(self, idx: Optional[int] = None) -> Union[str, List[str]]:
+        """LaTeX form of the selected equation(s)."""
+        from ..utils.export import to_latex
+
+        self._check_fitted()
+        if self._MULTITARGET:
+            return [
+                to_latex(recs[i if idx is None else idx].tree,
+                         variable_names=self.variable_names_)
+                for recs, i in zip(self.equations_, self.best_idx_)
+            ]
+        i = int(idx) if idx is not None else int(self.best_idx_)
+        return to_latex(self.equations_[i].tree,
+                        variable_names=self.variable_names_)
+
+    def sympy(self, idx: Optional[int] = None):
+        """SymPy expression of the selected equation (requires sympy)."""
+        from ..utils.export import to_sympy
+
+        self._check_fitted()
+        if self._MULTITARGET:
+            return [
+                to_sympy(recs[i if idx is None else idx].tree,
+                         variable_names=self.variable_names_)
+                for recs, i in zip(self.equations_, self.best_idx_)
+            ]
+        i = int(idx) if idx is not None else int(self.best_idx_)
+        return to_sympy(self.equations_[i].tree,
+                        variable_names=self.variable_names_)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        fitted = "fitted" if self.equations_ is not None else "unfitted"
+        return (
+            f"{type(self).__name__}(niterations={self.niterations}, "
+            f"{fitted})"
+        )
+
+
+class MultitargetSRRegressor(SRRegressor):
+    """Multi-output variant: ``y`` has shape (n, nout); one hall of fame
+    and one selected equation per output (src/MLJInterface.jl MTSR)."""
+
+    _MULTITARGET = True

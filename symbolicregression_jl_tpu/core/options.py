@@ -307,8 +307,8 @@ class Options:
         # 11. Performance
         batching: Optional[bool] = None,
         batch_size: Optional[int] = None,
-        turbo: bool = False,   # accepted for API parity; XLA always fuses
-        bumper: bool = False,  # accepted for API parity
+        turbo: Optional[bool] = None,  # None = auto: fused Pallas kernel on TPU
+        bumper: bool = False,  # accepted for API parity (no allocator to tune)
         autodiff_backend=None,  # ignored: gradients always via jax.grad
         # 12. Determinism
         deterministic: bool = False,
@@ -324,7 +324,9 @@ class Options:
         recorder_file: str = "recorder.json",
         # TPU-specific extensions:
         eval_dtype: str = "float32",
-        mutation_attempts: int = 10,  # max_attempts, src/Mutate.jl:201
+        mutation_attempts: int = 5,  # speculative batch width (reference's
+        # sequential retry cap is 10, src/Mutate.jl:201; expected successes
+        # land in the first few, and each attempt costs real TPU time)
     ):
         d = _V2_DEFAULTS
         if defaults is not None:
@@ -469,7 +471,7 @@ class Options:
 
         self.batching = bool(batching if batching is not None else d["batching"])
         self.batch_size = int(batch_size if batch_size is not None else d["batch_size"])
-        self.turbo = bool(turbo)
+        self.turbo = turbo  # tri-state: None=auto / True / False
         self.bumper = bool(bumper)
         self.autodiff_backend = autodiff_backend
 
@@ -499,6 +501,13 @@ class Options:
     @property
     def nops(self):
         return self.operators.nops
+
+    @property
+    def resolved_loss_function(self):
+        """The custom whole-prediction loss hook, if any (loss_function
+        takes precedence over loss_function_expression, matching the
+        reference's dispatch order, src/LossFunctions.jl:139-159)."""
+        return self.loss_function or self.loss_function_expression
 
     # Warm-start option compatibility (check_warm_start_compatibility,
     # /root/reference/src/OptionsStruct.jl:314-336).

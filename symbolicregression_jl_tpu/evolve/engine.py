@@ -30,7 +30,11 @@ from ..core.options import Options
 from ..ops.complexity import ComplexityTables, build_complexity_tables, \
     compute_complexity_batch
 from ..ops.encoding import TreeBatch
-from .constant_opt import OptimizerConfig, optimize_constants_batch
+from .constant_opt import (
+    OptimizerConfig,
+    optimize_constants_batch,
+    optimize_constants_fused,
+)
 from .population import PopulationState, init_population
 from .simplify import fold_constants_batch
 from .step import (
@@ -97,6 +101,16 @@ class Engine:
         self.window_size = float(window_size)
         self._iteration = jax.jit(self._iteration_impl, donate_argnums=(0,))
         self._init_state = jax.jit(self._init_state_impl, static_argnums=(2,))
+        # (cost, loss, complexity) for a flat batch of host-encoded trees —
+        # the guess-seeding / warm-start re-eval path.
+        self._eval_cost = jax.jit(
+            lambda trees, data: eval_cost_batch(
+                trees, data, self.options.elementwise_loss, self.tables,
+                self.cfg.operators, self.cfg.parsimony,
+                turbo=self.cfg.turbo, interpret=self.cfg.interpret,
+                loss_function=self.options.resolved_loss_function,
+            )
+        )
 
     # ------------------------------------------------------------------
     def init_state(self, key, data: DeviceData, n_islands: int,
@@ -121,6 +135,8 @@ class Engine:
             lambda t: eval_cost_batch(
                 t, data, self.options.elementwise_loss, self.tables,
                 cfg.operators, cfg.parsimony,
+                turbo=cfg.turbo, interpret=cfg.interpret,
+                loss_function=self.options.resolved_loss_function,
             )
         )(trees)
 
@@ -197,20 +213,51 @@ class Engine:
             )(pops.trees)
             pops = dataclasses.replace(pops, trees=folded)
 
+        # A fixed-size random subset per island keeps the grad-BFGS vmap's
+        # rematerialized buffers bounded instead of scaling with P. Each
+        # selected slot is gated by a bernoulli so the *expected* optimized
+        # count is exactly P * optimizer_probability, matching the
+        # reference's per-member coin flip (src/SingleIteration.jl:77-85)
+        # even when that product is < 0.5.
+        k_sel = max(1, round(P * options.optimizer_probability))
+        gate_p = min(P * options.optimizer_probability / k_sel, 1.0)
         if options.should_optimize_constants and options.optimizer_probability > 0:
-            ko1, ko2 = jax.random.split(k_opt)
-            do_opt = jax.random.bernoulli(
-                ko1, options.optimizer_probability, (I, P)
-            )
-            opt_keys = jax.random.split(ko2, I)
+            ko1, ko2, ko3 = jax.random.split(k_opt, 3)
+            scores = jax.random.uniform(ko1, (I, P))
+            _, sel_idx = jax.lax.top_k(scores, k_sel)  # [I, k_sel]
+            gate = jax.random.bernoulli(ko3, gate_p, (I, k_sel))
 
-            def island_opt(k, trees, do):
-                return optimize_constants_batch(
-                    k, trees, do, data, el_loss, cfg.operators, self.opt_cfg,
-                    batch_idx=batch_idx,
+            if cfg.turbo:
+                # One flattened launch across all islands: the fused BFGS
+                # batches its line search through the Pallas kernel.
+                sub = jax.vmap(
+                    lambda t, i: jax.tree.map(
+                        lambda x: jnp.take(x, i, axis=0), t
+                    )
+                )(pops.trees, sel_idx)
+                flat_sub = jax.tree.map(
+                    lambda x: x.reshape((I * k_sel,) + x.shape[2:]), sub
                 )
-            new_const, improved, _, f_calls = jax.vmap(island_opt)(
-                opt_keys, pops.trees, do_opt
+                new_const_flat, improved, _, f_calls = optimize_constants_fused(
+                    ko2, flat_sub, gate.reshape(I * k_sel), data,
+                    el_loss, cfg.operators, self.opt_cfg,
+                    batch_idx=batch_idx, interpret=cfg.interpret,
+                )
+                new_const_sub = new_const_flat.reshape(I, k_sel, -1)
+            else:
+                opt_keys = jax.random.split(ko2, I)
+
+                def island_opt(k, trees: TreeBatch, idx, g):
+                    sub = jax.tree.map(lambda x: jnp.take(x, idx, axis=0), trees)
+                    return optimize_constants_batch(
+                        k, sub, g, data, el_loss,
+                        cfg.operators, self.opt_cfg, batch_idx=batch_idx,
+                    )
+                new_const_sub, improved, _, f_calls = jax.vmap(island_opt)(
+                    opt_keys, pops.trees, sel_idx, gate
+                )
+            new_const = jax.vmap(lambda c, i, nc: c.at[i].set(nc))(
+                pops.trees.const, sel_idx, new_const_sub
             )
             pops = dataclasses.replace(
                 pops, trees=dataclasses.replace(pops.trees, const=new_const)
@@ -222,6 +269,8 @@ class Engine:
         cost, loss, cx = jax.vmap(
             lambda t: eval_cost_batch(
                 t, data, el_loss, tables, cfg.operators, cfg.parsimony,
+                turbo=cfg.turbo, interpret=cfg.interpret,
+                loss_function=options.resolved_loss_function,
             )
         )(pops.trees)
         pops = dataclasses.replace(pops, cost=cost, loss=loss, complexity=cx)

@@ -58,7 +58,11 @@ def _slot_mask(tree: TreeBatch):
     return jnp.arange(tree.arity.shape[0]) < tree.length
 
 
-def _structure(tree: TreeBatch):
+def _structure(tree: TreeBatch, structure=None):
+    """(child, size, depth); pass a precomputed tuple to avoid re-deriving
+    it in every mutation branch of a speculative attempt."""
+    if structure is not None:
+        return structure
     return _tree_structure_single(tree.arity, tree.length)
 
 
@@ -134,10 +138,10 @@ def mutate_feature(key, tree: TreeBatch, ctx: MutationContext):
 # ---------------------------------------------------------------------------
 
 
-def swap_operands(key, tree: TreeBatch, ctx: MutationContext):
+def swap_operands(key, tree: TreeBatch, ctx: MutationContext, structure=None):
     """Swap the two child spans of a random binary node (:83-96)."""
     L = ctx.max_nodes
-    child, size, _ = _structure(tree)
+    child, size, _ = _structure(tree, structure)
     mask = _slot_mask(tree) & (tree.arity == 2)
     k_node, has_any = masked_choice(key, mask)
     c1 = child[k_node, 0]
@@ -151,11 +155,11 @@ def swap_operands(key, tree: TreeBatch, ctx: MutationContext):
     return _select_tree(has_any, new_tree, tree), ok | ~has_any
 
 
-def delete_node(key, tree: TreeBatch, ctx: MutationContext):
+def delete_node(key, tree: TreeBatch, ctx: MutationContext, structure=None):
     """Splice out a random operator node, keeping one child (:336-356)."""
     L = ctx.max_nodes
     k1, k2 = jax.random.split(key)
-    child, size, _ = _structure(tree)
+    child, size, _ = _structure(tree, structure)
     mask = _slot_mask(tree) & (tree.arity > 0)
     k_node, has_any = masked_choice(k1, mask)
     carry_i = randint_dyn(k2, jnp.maximum(tree.arity[k_node], 1))
@@ -249,20 +253,20 @@ def _write_op_slot(scratch, a, o):
     return arity, op, feat, const
 
 
-def add_node(key, tree: TreeBatch, ctx: MutationContext):
+def add_node(key, tree: TreeBatch, ctx: MutationContext, structure=None):
     """append/prepend a random op, 50/50 (src/Mutate.jl:479-497)."""
     k0, k1 = jax.random.split(key)
     do_append = jax.random.bernoulli(k0)
-    appended, ok_a = append_random_op(k1, tree, ctx)
+    appended, ok_a = append_random_op(k1, tree, ctx, structure)
     prepended, ok_p = prepend_random_op(k1, tree, ctx)
     out = _select_tree(do_append, appended, prepended)
     return out, jnp.where(do_append, ok_a, ok_p)
 
 
-def append_random_op(key, tree: TreeBatch, ctx: MutationContext):
+def append_random_op(key, tree: TreeBatch, ctx: MutationContext, structure=None):
     """Replace a random leaf with op(random leaves) (:199-226)."""
     k1, k2, k3 = jax.random.split(key, 3)
-    child, size, _ = _structure(tree)
+    child, size, _ = _structure(tree, structure)
     mask = _slot_mask(tree) & (tree.arity == 0)
     k_leaf, has_any = masked_choice(k1, mask)
     a, o, any_op = _sample_new_op(k2, ctx)
@@ -275,10 +279,10 @@ def append_random_op(key, tree: TreeBatch, ctx: MutationContext):
     return _select_tree(valid, new_tree, tree), ok | ~valid
 
 
-def insert_random_op(key, tree: TreeBatch, ctx: MutationContext):
+def insert_random_op(key, tree: TreeBatch, ctx: MutationContext, structure=None):
     """Wrap a random node inside a new op (:243-272)."""
     k1, k2, k3, k4 = jax.random.split(key, 4)
-    child, size, _ = _structure(tree)
+    child, size, _ = _structure(tree, structure)
     mask = _slot_mask(tree)
     k_node, has_any = masked_choice(k1, mask)
     a, o, any_op = _sample_new_op(k2, ctx)
@@ -306,7 +310,7 @@ def prepend_random_op(key, tree: TreeBatch, ctx: MutationContext):
     return _select_tree(any_op, new_tree, tree), ok | ~any_op
 
 
-def rotate_tree(key, tree: TreeBatch, ctx: MutationContext):
+def rotate_tree(key, tree: TreeBatch, ctx: MutationContext, structure=None):
     """AVL-style random rotation (randomly_rotate_tree!, :594-633).
 
     Chooses a rotation root R (an operator node with at least one operator
@@ -317,7 +321,7 @@ def rotate_tree(key, tree: TreeBatch, ctx: MutationContext):
     """
     L = ctx.max_nodes
     k1, k2, k3 = jax.random.split(key, 3)
-    child, size, _ = _structure(tree)
+    child, size, _ = _structure(tree, structure)
     slot_ok = _slot_mask(tree)
     child_arity = tree.arity[jnp.clip(child, 0, L - 1)]  # [L, A]
     has_op_child = jnp.any(
@@ -376,12 +380,13 @@ def rotate_tree(key, tree: TreeBatch, ctx: MutationContext):
     return _select_tree(has_root, new_tree, tree), ok | ~has_root
 
 
-def crossover_trees(key, tree1: TreeBatch, tree2: TreeBatch, ctx: MutationContext):
+def crossover_trees(key, tree1: TreeBatch, tree2: TreeBatch, ctx: MutationContext,
+                    structure1=None, structure2=None):
     """Random subtree exchange (crossover_trees, :488-518)."""
     L = ctx.max_nodes
     k1, k2 = jax.random.split(key)
-    _, size1, _ = _structure(tree1)
-    _, size2, _ = _structure(tree2)
+    _, size1, _ = _structure(tree1, structure1)
+    _, size2, _ = _structure(tree2, structure2)
     n1, _ = masked_choice(k1, _slot_mask(tree1))
     n2, _ = masked_choice(k2, _slot_mask(tree2))
     s1, l1 = _span(size1, n1)
@@ -470,4 +475,12 @@ def randomize_tree(key, tree: TreeBatch, cur_maxsize, ctx: MutationContext):
 
 
 def _select_tree(pred, a: TreeBatch, b: TreeBatch) -> TreeBatch:
-    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+    """Elementwise tree select. ``pred`` has batch shape; it is broadcast
+    against each field's extra trailing dims (slot axis etc.)."""
+    pred = jnp.asarray(pred)
+
+    def sel(x, y):
+        p = pred.reshape(pred.shape + (1,) * (x.ndim - pred.ndim))
+        return jnp.where(p, x, y)
+
+    return jax.tree.map(sel, a, b)

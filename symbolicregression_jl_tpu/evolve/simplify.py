@@ -51,7 +51,7 @@ def _fold_single(tree: TreeBatch, X1, operators):
 
     (_, _), is_const = jax.lax.scan(
         step, (jnp.zeros((L,), jnp.bool_), jnp.int32(0)),
-        jnp.arange(L, dtype=jnp.int32),
+        jnp.arange(L, dtype=jnp.int32), unroll=True,
     )
 
     # Node values on the dummy row: const-subtree values are X-independent.
@@ -78,7 +78,7 @@ def _fold_single(tree: TreeBatch, X1, operators):
 
     (buf,), _ = jax.lax.scan(
         eval_step, (jnp.zeros((L, 1), tree.const.dtype),),
-        jnp.arange(L, dtype=jnp.int32),
+        jnp.arange(L, dtype=jnp.int32), unroll=True,
     )
     values = buf[:, 0]
 

@@ -32,6 +32,7 @@ from ..ops.complexity import (
 )
 from ..ops.encoding import LEAF_CONST, TreeBatch, tree_structure_arrays
 from ..ops.eval import eval_tree_batch
+from ..ops.fused_eval import fused_loss, supports_fused_eval
 from ..ops.operators import OperatorSet
 from . import mutation as M
 from .population import PopulationState
@@ -72,6 +73,8 @@ class EvolveConfig(NamedTuple):
     ncycles: int
     batching: bool
     batch_size: int
+    turbo: bool        # use the fused Pallas eval kernel
+    interpret: bool    # pallas interpret mode (non-TPU backends)
 
     @property
     def n_slots(self) -> int:
@@ -90,6 +93,12 @@ class EvolveConfig(NamedTuple):
 
 
 def evolve_config_from_options(options: Options, nfeatures: int) -> EvolveConfig:
+    on_tpu = jax.default_backend() == "tpu"
+    turbo = options.turbo if options.turbo is not None else on_tpu
+    if turbo and not supports_fused_eval(options.operators):
+        turbo = False
+    if options.loss_function is not None or options.loss_function_expression is not None:
+        turbo = False  # custom whole-prediction losses use the jnp path
     return EvolveConfig(
         operators=options.operators,
         maxsize=options.maxsize,
@@ -114,6 +123,8 @@ def evolve_config_from_options(options: Options, nfeatures: int) -> EvolveConfig
         ncycles=options.ncycles_per_iteration,
         batching=options.batching,
         batch_size=options.batch_size,
+        turbo=turbo,
+        interpret=not on_tpu,
     )
 
 
@@ -174,8 +185,12 @@ def _condition_weights(base_w, tree: TreeBatch, complexity, cur_maxsize,
 
 
 def _apply_kind(kind, key, tree: TreeBatch, temperature, cur_maxsize,
-                cfg: EvolveConfig):
-    """Apply mutation `kind` to `tree`; returns (tree, structural_ok)."""
+                cfg: EvolveConfig, structure=None):
+    """Apply mutation `kind` to `tree`; returns (tree, structural_ok).
+
+    ``structure`` is the precomputed (child, size, depth) of ``tree`` —
+    shared by every branch and every speculative attempt.
+    """
     mctx = cfg.mctx
     branches = []
 
@@ -185,11 +200,11 @@ def _apply_kind(kind, key, tree: TreeBatch, temperature, cur_maxsize,
     add("mutate_constant", lambda k: M.mutate_constant(k, tree, temperature, mctx))
     add("mutate_operator", lambda k: M.mutate_operator(k, tree, mctx))
     add("mutate_feature", lambda k: M.mutate_feature(k, tree, mctx))
-    add("swap_operands", lambda k: M.swap_operands(k, tree, mctx))
-    add("rotate_tree", lambda k: M.rotate_tree(k, tree, mctx))
-    add("add_node", lambda k: M.add_node(k, tree, mctx))
-    add("insert_node", lambda k: M.insert_random_op(k, tree, mctx))
-    add("delete_node", lambda k: M.delete_node(k, tree, mctx))
+    add("swap_operands", lambda k: M.swap_operands(k, tree, mctx, structure))
+    add("rotate_tree", lambda k: M.rotate_tree(k, tree, mctx, structure))
+    add("add_node", lambda k: M.add_node(k, tree, mctx, structure))
+    add("insert_node", lambda k: M.insert_random_op(k, tree, mctx, structure))
+    add("delete_node", lambda k: M.delete_node(k, tree, mctx, structure))
     add("randomize", lambda k: M.randomize_tree(k, tree, cur_maxsize, mctx))
 
     out_tree = tree
@@ -224,8 +239,14 @@ def _check_single(tree: TreeBatch, options, tables, cur_maxsize):
 
 
 def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
-                    operators, parsimony, batch_idx=None, params=None):
-    """Batched eval_cost (src/LossFunctions.jl:193-209): (cost, loss, complexity)."""
+                    operators, parsimony, batch_idx=None, params=None,
+                    turbo=False, interpret=False, loss_function=None):
+    """Batched eval_cost (src/LossFunctions.jl:193-209): (cost, loss, complexity).
+
+    ``turbo`` routes through the fused Pallas eval+loss kernel (the hot
+    path); params (parametric expressions) and grad paths use the jnp
+    interpreter.
+    """
     if batch_idx is None:
         X = data.Xt
         y = data.y
@@ -234,8 +255,27 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
         X = jnp.take(data.Xt, batch_idx, axis=1)
         y = jnp.take(data.y, batch_idx)
         w = None if data.weights is None else jnp.take(data.weights, batch_idx)
-    pred, valid = eval_tree_batch(trees, X, operators, params=params)
-    loss = aggregate_loss(elementwise_loss, pred, y, valid, w)
+    if turbo and params is None and loss_function is None:
+        loss, valid = fused_loss(
+            trees, X, y, w, operators, elementwise_loss, interpret=interpret
+        )
+    else:
+        pred, valid = eval_tree_batch(trees, X, operators, params=params)
+        if loss_function is not None:
+            # Custom whole-prediction loss (the loss_function /
+            # loss_function_expression hook, src/LossFunctions.jl:139-159):
+            # a jnp-traceable (pred[n], y[n], weights, valid) -> scalar.
+            flat_pred = pred.reshape(-1, pred.shape[-1])
+            flat_valid = valid.reshape(-1)
+            loss = jax.vmap(lambda p, v: loss_function(p, y, w, v))(
+                flat_pred, flat_valid
+            ).reshape(valid.shape)
+            loss = jnp.where(
+                valid & ~jnp.isnan(loss), loss,
+                jnp.asarray(jnp.inf, loss.dtype),
+            )
+        else:
+            loss = aggregate_loss(elementwise_loss, pred, y, valid, w)
     complexity = compute_complexity_batch(trees, tables)
     cost = loss_to_cost(loss, data.baseline_loss, data.use_baseline, complexity,
                         parsimony)
@@ -295,9 +335,17 @@ def generation_step(
         for kid in _IMMEDIATE_KINDS:
             immediate = immediate | (kind == kid)
 
+        # One structure derivation serves all attempts and branches (the
+        # input tree is the same); crossover reuses the same tuples below.
+        struct1 = M._tree_structure_single(m1.trees.arity, m1.trees.length)
+        struct2 = M._tree_structure_single(m2.trees.arity, m2.trees.length)
+
         att_keys = jax.random.split(ks[4], A)
         att_trees, att_ok = jax.vmap(
-            lambda ak: _apply_kind(kind, ak, m1.trees, temperature, cur_maxsize, cfg)
+            lambda ak: _apply_kind(
+                kind, ak, m1.trees, temperature, cur_maxsize, cfg,
+                structure=struct1,
+            )
         )(att_keys)
         child, size, depth = tree_structure_arrays(att_trees)
         att_cons = check_constraints_batch(
@@ -309,7 +357,9 @@ def generation_step(
         # ---- crossover path ----
         xa_keys = jax.random.split(ks[5], A)
         c1s, c2s, ok1s, ok2s = jax.vmap(
-            lambda ak: M.crossover_trees(ak, m1.trees, m2.trees, cfg.mctx)
+            lambda ak: M.crossover_trees(
+                ak, m1.trees, m2.trees, cfg.mctx, struct1, struct2
+            )
         )(xa_keys)
         ch1, sz1, dp1 = tree_structure_arrays(c1s)
         cons1 = check_constraints_batch(c1s, options, tables, cur_maxsize, ch1, sz1, dp1)
@@ -337,7 +387,8 @@ def generation_step(
     )  # [B, 2, ...]
     cost, loss, complexity = eval_cost_batch(
         both, data, elementwise_loss, tables, cfg.operators, cfg.parsimony,
-        batch_idx=batch_idx,
+        batch_idx=batch_idx, turbo=cfg.turbo, interpret=cfg.interpret,
+        loss_function=options.resolved_loss_function,
     )
     needs_eval = jnp.stack([needs_eval1, needs_eval2], axis=1)
     num_evals = jnp.sum(needs_eval.astype(jnp.float32))
@@ -373,7 +424,7 @@ def generation_step(
         jnp.where(accepted_mut, True, ~jnp.bool_(cfg.skip_mutation_failures)),
     )
     baby1_tree = M._select_tree(
-        (accepted_mut & ~immediate)[:, None], cand1, pop.member(i1).trees
+        accepted_mut & ~immediate, cand1, pop.member(i1).trees
     )
     baby1_cost = jnp.where(accepted_mut & ~immediate, after_cost, m1_cost)
     baby1_loss = jnp.where(accepted_mut & ~immediate, after_loss, m1_loss)
@@ -386,7 +437,7 @@ def generation_step(
 
     replace1 = jnp.where(is_xover, xo_replace, mut_replace)
     replace2 = is_xover & xo_replace
-    baby1_tree = M._select_tree(is_xover[:, None], cand1, baby1_tree)
+    baby1_tree = M._select_tree(is_xover, cand1, baby1_tree)
     baby1_cost = jnp.where(is_xover, cost[:, 0], baby1_cost)
     baby1_loss = jnp.where(is_xover, loss[:, 0], baby1_loss)
     baby1_cx = jnp.where(is_xover, complexity[:, 0], baby1_cx)

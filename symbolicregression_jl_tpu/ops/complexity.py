@@ -109,7 +109,7 @@ def _postfix_max_plus(vals: jax.Array, arity: jax.Array) -> jax.Array:
         return (stack, new_sp), r_k
 
     init = (jnp.zeros((L,), vals.dtype), jnp.int32(0))
-    _, r = jax.lax.scan(step, init, jnp.arange(L, dtype=jnp.int32))
+    _, r = jax.lax.scan(step, init, jnp.arange(L, dtype=jnp.int32), unroll=True)
     return r
 
 

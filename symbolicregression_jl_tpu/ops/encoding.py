@@ -265,7 +265,12 @@ def _tree_structure_single(arity: jax.Array, length: jax.Array):
         jnp.zeros((L,), jnp.int32),
         jnp.int32(0),
     )
-    _, (child, size, depth) = jax.lax.scan(step, init, jnp.arange(L, dtype=jnp.int32))
+    # Partial unroll: L is small (maxsize ~30) and each step is scalar
+    # work; unrolling amortizes loop overhead without the compile-time
+    # blowup of a full unroll at every call site.
+    _, (child, size, depth) = jax.lax.scan(
+        step, init, jnp.arange(L, dtype=jnp.int32), unroll=8
+    )
     return child, size, depth
 
 

@@ -1,0 +1,285 @@
+"""Fused Pallas TPU kernel: postfix tree eval + loss reduction per tree.
+
+This is the framework's hot op (the "turbo" layer — the role
+LoopVectorization plays in the reference,
+/root/reference/src/InterfaceDynamicExpressions.jl:71-81). The jnp
+interpreter in ops/eval.py materializes a [T, L, n] value buffer in HBM
+and computes *every* operator at every slot; this kernel instead:
+
+- keeps a per-tree evaluation **stack** in VMEM (postfix order means each
+  node's operands are the top of the stack — no child-index gathers);
+- dispatches exactly one operator per node via `lax.switch` on the SMEM
+  op code;
+- fuses the elementwise-loss + row reduction, so HBM traffic is just the
+  X/y row tiles (shared across all trees) and one scalar pair per tree.
+
+Outputs per tree: (loss_sum, valid) accumulated over row tiles; the
+wrapper converts to mean loss with the reference's invalid ⇒ Inf
+semantics (/root/reference/src/LossFunctions.jl:96-99).
+
+Stack destinations are data, not control: dst[k] = (exclusive-cumsum of
+(1 - arity))[k] - arity[k] is precomputed with jnp before the kernel, so
+the kernel's only dynamic indexing is the stack-slot store/load.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .encoding import LEAF_CONST, LEAF_VAR, TreeBatch
+from .operators import OperatorSet
+
+__all__ = ["fused_loss", "stack_positions", "supports_fused_eval"]
+
+
+def stack_positions(arity: jax.Array) -> jax.Array:
+    """dst[k]: stack slot written by postfix slot k (see module doc)."""
+    one_minus_a = 1 - arity
+    excl = jnp.cumsum(one_minus_a, axis=-1) - one_minus_a
+    return excl - arity
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def supports_fused_eval(operators: OperatorSet) -> bool:
+    """The kernel handles arity <= 2 operator sets (current encoding)."""
+    return all(d in (1, 2) for d in operators.ops.keys())
+
+
+def _tree_kernel_body(
+    t: int,
+    k,
+    arity_ref,
+    op_ref,
+    feat_ref,
+    dst_ref,
+    const_ref,
+    x_ref,
+    stack_ref,
+    vmask,
+    unary_fns,
+    binary_fns,
+):
+    """Evaluate slot k of tree t (one step of the fori_loop).
+
+    No in-tree guard: padding slots are arity-0 const-0 leaves whose
+    (clamped) stack writes land above the live region — slot 0, where the
+    root value ends up, is never touched by them (the running stack
+    pointer after the root is >= 1). Validity is accumulated as a per-row
+    vector mask (one cross-lane reduction at the end instead of one per
+    slot); a row is valid iff every node output at that row is finite —
+    equivalent to the reference's per-node buffer check
+    (/root/reference/src/LossFunctions.jl:96-99 semantics).
+    """
+    a = arity_ref[t, k]
+    o = op_ref[t, k]
+    d = dst_ref[t, k]
+    tile = stack_ref.shape[-1]
+
+    def leaf_val():
+        x_row = x_ref[feat_ref[t, k], :]
+        c = jnp.full((tile,), const_ref[t, k], dtype=x_ref.dtype)
+        return jnp.where(o == LEAF_CONST, c, x_row)
+
+    def unary_val():
+        child = stack_ref[t, d, :]
+        if len(unary_fns) == 1:
+            return unary_fns[0](child)
+        return jax.lax.switch(o, unary_fns, child)
+
+    def binary_val():
+        l = stack_ref[t, d, :]
+        r = stack_ref[t, d + 1, :]
+        if len(binary_fns) == 1:
+            return binary_fns[0](l, r)
+        return jax.lax.switch(o, binary_fns, l, r)
+
+    branches = [leaf_val]
+    branches.append(unary_val if unary_fns else leaf_val)
+    branches.append(binary_val if binary_fns else leaf_val)
+    val = jax.lax.switch(a, branches)
+
+    stack_ref[t, d, :] = val
+    # float accumulator: Mosaic miscompiles bool vectors as loop carries
+    return vmask * jnp.isfinite(val).astype(vmask.dtype)
+
+
+def _make_kernel(
+    operators: OperatorSet,
+    loss_fn: Callable,
+    max_nodes: int,
+    tree_block: int,
+    weighted: bool,
+):
+    unary_fns = tuple(op.fn for op in operators.unary)
+    binary_fns = tuple(op.fn for op in operators.binary)
+
+    def kernel(
+        arity_ref,   # SMEM [TB, L]
+        op_ref,      # SMEM [TB, L]
+        feat_ref,    # SMEM [TB, L]
+        dst_ref,     # SMEM [TB, L] (clamped to stack size by the wrapper)
+        const_ref,   # SMEM [TB, L] f32
+        x_ref,       # VMEM [F, TILE]
+        y_ref,       # VMEM [1, TILE]
+        w_ref,       # VMEM [1, TILE] (ones when unweighted)
+        mask_ref,    # VMEM [1, TILE] f32: 1.0 for real rows, 0.0 padding
+        loss_ref,    # SMEM out [TB, 1] f32
+        valid_ref,   # SMEM out [TB, 1] int32
+        stack_ref,   # VMEM scratch [TB, S, TILE]
+    ):
+        j = pl.program_id(1)
+        y_row = y_ref[0, :]
+        mask_row = mask_ref[0, :] > 0
+        w_row = w_ref[0, :] * mask_ref[0, :]
+        tile = y_row.shape[0]
+
+        for t in range(tree_block):
+            def body(k, vmask):
+                return _tree_kernel_body(
+                    t, k, arity_ref, op_ref, feat_ref, dst_ref, const_ref,
+                    x_ref, stack_ref, vmask,
+                    unary_fns, binary_fns,
+                )
+
+            vmask = jax.lax.fori_loop(
+                0, max_nodes, body, jnp.ones((tile,), y_row.dtype)
+            )
+            valid = jnp.all((vmask > 0) | jnp.logical_not(mask_row))
+            pred = stack_ref[t, 0, :]
+            elt = loss_fn(pred, y_row)
+            # Zero padded/invalid rows *before* the sum so NaN padding
+            # can't poison the accumulator; validity is tracked separately.
+            elt = jnp.where(w_row > 0, elt, 0.0)
+            partial = jnp.sum(elt * w_row)
+            partial_ok = jnp.int32(valid & jnp.isfinite(partial))
+
+            @pl.when(j == 0)
+            def _():
+                loss_ref[t, 0] = partial
+                valid_ref[t, 0] = partial_ok
+
+            @pl.when(j != 0)
+            def _():
+                loss_ref[t, 0] = loss_ref[t, 0] + partial
+                valid_ref[t, 0] = valid_ref[t, 0] & partial_ok
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "operators", "loss_fn", "tree_block", "tile_rows", "interpret",
+    ),
+)
+def fused_loss(
+    trees: TreeBatch,
+    X: jax.Array,               # [F, n]
+    y: jax.Array,               # [n]
+    weights: Optional[jax.Array],  # [n] or None
+    operators: OperatorSet,
+    loss_fn: Callable,
+    *,
+    tree_block: int = 8,
+    tile_rows: int = 2048,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Mean elementwise loss per tree, fused on TPU.
+
+    Returns ``(loss[...], valid[...])`` with the TreeBatch's batch dims;
+    invalid trees get loss=inf (matching aggregate_loss semantics).
+    """
+    batch_shape = trees.batch_shape
+    flat = trees.reshape(-1) if batch_shape else trees.reshape(1)
+    T = flat.length.shape[0]
+    L = flat.arity.shape[-1]
+    F, n = X.shape
+    dtype = X.dtype
+
+    TB = tree_block
+    TILE = min(tile_rows, _round_up(n, 128))
+    # Keep the stack scratch + row tiles inside the ~16MB VMEM budget.
+    S_est = L // 2 + 2
+    bytes_per = jnp.dtype(dtype).itemsize
+    while TB * S_est * TILE * bytes_per > 10 * 2**20 and TILE > 512:
+        TILE //= 2
+    while TB * S_est * TILE * bytes_per > 10 * 2**20 and TB > 8:
+        TB //= 2
+    T_pad = _round_up(T, TB)
+    n_pad = _round_up(n, TILE)
+
+    def pad_trees(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
+                       constant_values=fill)
+
+    S = L // 2 + 2  # max postfix stack depth for L slots
+    arity = pad_trees(flat.arity)
+    op = pad_trees(flat.op)
+    feat = jnp.clip(pad_trees(flat.feat), 0, F - 1)
+    const = pad_trees(flat.const).astype(dtype)
+    # Padding slots' running stack positions keep growing past the live
+    # region; clamp into the scratch slot so their writes are in-bounds
+    # (they never touch slot 0 — see kernel docstring).
+    dst = jnp.clip(stack_positions(arity), 0, S - 1)
+
+    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+    yp = jnp.pad(y.reshape(1, n), ((0, 0), (0, n_pad - n)))
+    w = jnp.ones((1, n), dtype) if weights is None else weights.reshape(1, n).astype(dtype)
+    wp = jnp.pad(w, ((0, 0), (0, n_pad - n)))
+    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
+
+    grid = (T_pad // TB, n_pad // TILE)
+    kernel = _make_kernel(operators, loss_fn, L, TB, weights is not None)
+
+    smem_i32 = lambda shape: pl.BlockSpec(
+        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
+    )
+    row_spec = pl.BlockSpec((1, TILE), lambda i, j: (0, j))
+
+    loss_sum, valid = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            smem_i32((TB, L)),                       # arity
+            smem_i32((TB, L)),                       # op
+            smem_i32((TB, L)),                       # feat
+            smem_i32((TB, L)),                       # dst
+            pl.BlockSpec((TB, L), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),   # const
+            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),  # X
+            row_spec,                                # y
+            row_spec,                                # w
+            row_spec,                                # mask
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_pad, 1), dtype),
+            jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((TB, S, TILE), dtype)],
+        interpret=interpret,
+    )(arity, op, feat, dst, const, Xp, yp, wp, maskp)
+
+    loss_sum = loss_sum[:T, 0]
+    valid = valid[:T, 0].astype(jnp.bool_)
+    denom = jnp.sum(w) if weights is not None else jnp.asarray(n, dtype)
+    loss = loss_sum / denom
+    loss = jnp.where(valid & jnp.isfinite(loss), loss, jnp.inf)
+    if batch_shape:
+        return loss.reshape(batch_shape), valid.reshape(batch_shape)
+    return loss[0], valid[0]

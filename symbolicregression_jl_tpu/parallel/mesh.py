@@ -1,0 +1,119 @@
+"""Device-mesh placement of the search state: islands × data sharding.
+
+The reference's distributed runtime is a master/worker RPC island model
+over Distributed.jl (/root/reference/src/SearchUtils.jl:289-308,
+/root/reference/src/Configure.jl). The TPU-native equivalent is a
+single-program SPMD design (SURVEY.md §5.8): the island axis of every
+population array is sharded over the mesh's ``island`` axis, and the
+dataset's row axis is sharded over the ``data`` axis. Cross-island
+operations inside the jitted iteration (migration pool all-gather, global
+hall-of-fame merge) lower to XLA collectives over ICI; the per-row loss
+reduction lowers to a psum over the ``data`` axis. Multi-host scaling uses
+the same program via ``jax.distributed.initialize`` — no user-function
+shipping is needed because closures compile into the program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "make_mesh",
+    "shard_search_state",
+    "shard_device_data",
+    "replicated",
+]
+
+ISLAND_AXIS = "island"
+DATA_AXIS = "data"
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    n_island_shards: Optional[int] = None,
+    n_data_shards: int = 1,
+) -> Mesh:
+    """Build an ``(island, data)`` mesh over the given (or all) devices.
+
+    By default all devices go to the island axis — the natural layout for
+    evolutionary search, where islands are embarrassingly parallel between
+    migrations. Use ``n_data_shards > 1`` for huge datasets where row
+    parallelism pays for its collectives.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n_island_shards is None:
+        n_island_shards = n // n_data_shards
+    if n_island_shards * n_data_shards != n:
+        raise ValueError(
+            f"mesh shape {n_island_shards}x{n_data_shards} != {n} devices"
+        )
+    dev_array = np.array(devices).reshape(n_island_shards, n_data_shards)
+    return Mesh(dev_array, (ISLAND_AXIS, DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _shard_leading(mesh: Mesh, x: jax.Array, axis_name: str) -> jax.Array:
+    spec = P(axis_name, *([None] * (x.ndim - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def shard_search_state(state, mesh: Mesh):
+    """Place a SearchDeviceState on the mesh: island-major arrays sharded
+    on the island axis, global state (HoF, stats, key) replicated.
+
+    The per-island pytrees (pops, birth, ref) all carry the island axis as
+    their leading dimension.
+    """
+    island_sharded = jax.tree.map(
+        lambda x: _shard_leading(mesh, x, ISLAND_AXIS), (state.pops, state.birth, state.ref)
+    )
+    pops, birth, ref = island_sharded
+    rep = replicated(mesh)
+    hof, stats = jax.tree.map(lambda x: jax.device_put(x, rep), (state.hof, state.stats))
+    import dataclasses
+
+    return dataclasses.replace(
+        state,
+        pops=pops,
+        birth=birth,
+        ref=ref,
+        hof=hof,
+        stats=stats,
+        num_evals=jax.device_put(state.num_evals, rep),
+        key=jax.device_put(state.key, rep),
+    )
+
+
+def shard_device_data(data, mesh: Mesh):
+    """Shard dataset rows over the ``data`` mesh axis (replicate when the
+    data axis has a single shard)."""
+    n_data = mesh.shape[DATA_AXIS]
+
+    def place(x, row_axis):
+        if x is None:
+            return None
+        if n_data == 1 or x.ndim == 0:
+            return jax.device_put(x, replicated(mesh))
+        spec = [None] * x.ndim
+        spec[row_axis] = DATA_AXIS
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    import dataclasses
+
+    return dataclasses.replace(
+        data,
+        Xt=place(data.Xt, 1),
+        y=place(data.y, 0),
+        weights=place(data.weights, 0),
+        class_idx=place(data.class_idx, 0),
+        baseline_loss=jax.device_put(data.baseline_loss, replicated(mesh)),
+        use_baseline=jax.device_put(data.use_baseline, replicated(mesh)),
+    )

@@ -7,13 +7,23 @@ in SURVEY.md §4's implication notes.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = flags + " --xla_force_host_platform_device_count=8"
+# The CPU backend's fast-math lowers transcendentals (log, gamma) with
+# ~1e-5 relative error, failing golden-value tests that pass on TPU.
+if "xla_cpu_enable_fast_math" not in flags:
+    flags = flags + " --xla_cpu_enable_fast_math=false"
+os.environ["XLA_FLAGS"] = flags.strip()
 os.environ.setdefault("SYMBOLIC_REGRESSION_IS_TESTING", "true")
+
+# The env var alone is not enough: this machine's sitecustomize pre-imports
+# jax with JAX_PLATFORMS=axon (TPU), latching the platform before conftest
+# runs. jax.config.update re-pins it after the fact.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache makes repeated test runs much faster.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

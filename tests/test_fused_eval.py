@@ -1,0 +1,152 @@
+"""Fused Pallas eval kernel vs the jnp interpreter (interpret mode on CPU).
+
+Mirrors the reference's LoopVectorization-extension tests — turbo SIMD
+correctness incl. NaN handling (test/integration/ext/loopvectorization/,
+SURVEY.md §4): the fast path must agree with the reference interpreter on
+values, validity, and NaN/Inf domain failures.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import symbolicregression_jl_tpu as sr
+from symbolicregression_jl_tpu.core.losses import aggregate_loss, l2_dist_loss, l1_dist_loss
+from symbolicregression_jl_tpu.evolve.population import init_population
+from symbolicregression_jl_tpu.evolve.step import evolve_config_from_options
+from symbolicregression_jl_tpu.ops.encoding import encode_population
+from symbolicregression_jl_tpu.ops.eval import eval_tree_batch
+from symbolicregression_jl_tpu.ops.fused_eval import fused_loss, stack_positions
+
+
+@pytest.fixture(scope="module")
+def setup():
+    opts = sr.Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "abs", "exp"],
+        maxsize=20,
+        save_to_file=False,
+    )
+    cfg = evolve_config_from_options(opts, 3)
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.uniform(-3, 3, (3, 257)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=257).astype(np.float32))
+    return opts, cfg, X, y
+
+
+def test_stack_positions():
+    # postfix [leaf, leaf, binop, leaf, binop]: ((a op b) op c)
+    arity = jnp.asarray([0, 0, 2, 0, 2])
+    dst = stack_positions(arity)
+    assert dst.tolist() == [0, 1, 0, 1, 0]
+
+
+def test_fused_matches_interpreter_on_exprs(setup):
+    opts, cfg, X, y = setup
+    opset = cfg.operators
+    exprs = [
+        sr.parse_expression("cos(2.13 * x1) + 0.5 * x2", opset),
+        sr.parse_expression("x1 * x2 - exp(x3 / 2.0)", opset),
+        sr.parse_expression("abs(x3) / (x1 - x1)", opset),  # 1/0 -> invalid
+        sr.parse_expression("1.5", opset),
+        sr.parse_expression("x1", opset),
+    ]
+    batch = encode_population(exprs, opts.maxsize, opset)
+    pred, v_ref = eval_tree_batch(batch, X, opset)
+    l_ref = aggregate_loss(l2_dist_loss, pred, y, v_ref)
+    l_fused, v_fused = fused_loss(
+        batch, X, y, None, opset, l2_dist_loss, interpret=True
+    )
+    assert np.array_equal(np.asarray(v_ref), np.asarray(v_fused))
+    ok = np.isfinite(np.asarray(l_ref))
+    assert np.allclose(
+        np.asarray(l_ref)[ok], np.asarray(l_fused)[ok], rtol=1e-5
+    )
+    assert np.all(np.isinf(np.asarray(l_fused)[~ok]))
+
+
+def test_fused_matches_on_random_population(setup):
+    opts, cfg, X, y = setup
+    trees = init_population(jax.random.PRNGKey(3), 64, cfg.mctx, jnp.float32)
+    pred, v_ref = eval_tree_batch(trees, X, cfg.operators)
+    l_ref = aggregate_loss(l2_dist_loss, pred, y, v_ref)
+    l_fused, v_fused = fused_loss(
+        trees, X, y, None, cfg.operators, l2_dist_loss, interpret=True
+    )
+    v_ref, v_fused = np.asarray(v_ref), np.asarray(v_fused)
+    assert (v_ref == v_fused).mean() >= 0.98  # fp-order edge cases allowed
+    both = v_ref & v_fused
+    assert np.allclose(
+        np.asarray(l_ref)[both], np.asarray(l_fused)[both], rtol=1e-4
+    )
+
+
+def test_fused_weighted_loss(setup):
+    opts, cfg, X, y = setup
+    opset = cfg.operators
+    n = X.shape[1]
+    w = jnp.asarray(
+        np.random.default_rng(1).uniform(0.5, 2.0, n).astype(np.float32)
+    )
+    batch = encode_population(
+        [sr.parse_expression("x1 + x2", opset)], opts.maxsize, opset
+    )
+    pred, v = eval_tree_batch(batch, X, opset)
+    l_ref = aggregate_loss(l1_dist_loss, pred, y, v, w)
+    l_fused, _ = fused_loss(
+        batch, X, y, w, opset, l1_dist_loss, interpret=True
+    )
+    assert np.allclose(float(l_ref[0]), float(l_fused[0]), rtol=1e-5)
+
+
+def test_fused_batch_dims(setup):
+    """Leading batch dims (islands) reshape correctly."""
+    opts, cfg, X, y = setup
+    trees = init_population(jax.random.PRNGKey(5), 12, cfg.mctx, jnp.float32)
+    nested = jax.tree.map(lambda x: x.reshape((3, 4) + x.shape[1:]), trees)
+    l_flat, v_flat = fused_loss(
+        trees, X, y, None, cfg.operators, l2_dist_loss, interpret=True
+    )
+    l_nest, v_nest = fused_loss(
+        nested, X, y, None, cfg.operators, l2_dist_loss, interpret=True
+    )
+    assert l_nest.shape == (3, 4)
+    assert np.allclose(
+        np.asarray(l_flat), np.asarray(l_nest).reshape(-1), equal_nan=True
+    )
+
+
+def test_fused_constant_optimizer(setup):
+    """Fused batched-line-search BFGS recovers known constants
+    (optimize_constants semantics, src/ConstantOptimization.jl:29-113)."""
+    from symbolicregression_jl_tpu.evolve.constant_opt import (
+        OptimizerConfig,
+        optimize_constants_fused,
+    )
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+
+    opts, cfg, X, y = setup
+    opset = cfg.operators
+    # target: y = 2.5*x1 - 1.25 ; start from wrong constants
+    Xh = np.asarray(X).T  # (n, 3)
+    yh = 2.5 * Xh[:, 0] - 1.25
+    ds = make_dataset(Xh, yh)
+    exprs = [
+        sr.parse_expression("1.0 * x1 - 0.1", opset),
+        sr.parse_expression("x2", opset),  # no constants: must be untouched
+    ]
+    batch = encode_population(exprs, opts.maxsize, opset)
+    new_const, improved, new_loss, f_calls = optimize_constants_fused(
+        jax.random.PRNGKey(0), batch, jnp.ones((2,), bool), ds.data,
+        l2_dist_loss, opset, OptimizerConfig(iterations=20, nrestarts=1),
+        interpret=True,
+    )
+    assert bool(improved[0])
+    assert float(new_loss[0]) < 1e-3
+    consts = np.asarray(new_const[0])
+    live = np.asarray(batch.arity[0]) == 0
+    got = sorted(np.round(consts[np.asarray(batch.op[0]) == 0][:2], 2).tolist())
+    assert not bool(improved[1])  # nothing to optimize
+    assert float(f_calls[0]) > 0

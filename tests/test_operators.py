@@ -56,7 +56,9 @@ class TestSafePow:
     ],
 )
 def test_safe_unary_domains(fn, good, good_val, bad):
-    assert np.allclose(fn(arr(good)), good_val, atol=1e-6)
+    # float32 transcendentals on XLA backends (CPU fast-math, TPU) carry
+    # ~1e-5 relative error; exact-value parity is not the contract here.
+    assert np.allclose(fn(arr(good)), good_val, rtol=1e-4, atol=1e-5)
     assert np.isnan(fn(arr(bad)))
 
 
@@ -74,7 +76,7 @@ def test_gamma_matches_scipy_and_poles():
 
     for x in (0.5, 1.0, 2.5, 4.0, -0.5, -1.5):
         got = float(ops.gamma(jnp.asarray([x], jnp.float32))[0])
-        assert got == pytest.approx(pygamma(x), rel=2e-4), x
+        assert got == pytest.approx(pygamma(x), rel=2e-3), x
     assert np.isnan(ops.gamma(arr(0.0)))  # pole -> inf -> NaN
 
 

@@ -1,0 +1,111 @@
+"""SRRegressor / MultitargetSRRegressor sklearn-contract tests.
+
+Mirrors the reference MLJ interface tests (test/integration/ext/mlj/,
+SURVEY.md §4): fit/predict/report flows, selection rule, warm-start
+refits, multi-target routing.
+"""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.api.regressor import (
+    MultitargetSRRegressor,
+    SRRegressor,
+    choose_best,
+)
+
+
+def _opts(**kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        maxsize=12,
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=20,
+        tournament_selection_n=6,
+        save_to_file=False,
+    )
+    base.update(kw)
+    return base
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (128, 2)).astype(np.float32)
+    y = 3.0 * X[:, 0] - X[:, 1]
+    return X, y
+
+
+def test_fit_predict_score(problem):
+    X, y = problem
+    model = SRRegressor(niterations=3, seed=0, **_opts())
+    model.fit(X, y)
+    assert model.equations_ is not None and len(model.equations_) >= 1
+    pred = model.predict(X)
+    assert pred.shape == (X.shape[0],)
+    assert model.score(X, y) > 0.5
+    rec = model.get_best()
+    assert rec.complexity >= 1 and np.isfinite(rec.loss)
+
+
+def test_predict_with_idx(problem):
+    X, y = problem
+    model = SRRegressor(niterations=2, seed=1, **_opts())
+    model.fit(X, y)
+    p0 = model.predict(X, idx=0)  # simplest frontier equation
+    assert p0.shape == (X.shape[0],)
+
+
+def test_unfitted_raises(problem):
+    X, y = problem
+    with pytest.raises(RuntimeError, match="not fitted"):
+        SRRegressor(**_opts()).predict(X)
+
+
+def test_warm_start_refit_continues(problem):
+    X, y = problem
+    model = SRRegressor(niterations=2, seed=2, **_opts())
+    model.fit(X, y)
+    loss1 = model.get_best().loss
+    model.fit(X, y)  # warm-start: runs 2 more iterations from saved state
+    assert model.fitted_iterations_ == 4
+    assert model.get_best().loss <= loss1 + 1e-6
+
+
+def test_multitarget(problem):
+    X, _ = problem
+    Y = np.stack([2.0 * X[:, 0], X[:, 1] + 1.0], axis=1)  # (n, 2)
+    model = MultitargetSRRegressor(niterations=2, seed=3, **_opts())
+    model.fit(X, Y)
+    assert len(model.equations_) == 2
+    pred = model.predict(X)
+    assert pred.shape == Y.shape
+    assert model.score(X, Y) > -1.0
+
+
+def test_choose_best_rule():
+    # max score among losses <= 1.5*min
+    idx = choose_best(
+        trees=[None] * 4,
+        losses=[10.0, 1.0, 0.9, 0.8],
+        scores=[0.0, 5.0, 1.0, 0.5],
+        complexities=[1, 3, 5, 7],
+    )
+    assert idx == 1  # loss 1.0 <= 1.2 threshold, highest score
+
+
+def test_latex_and_export(problem):
+    X, y = problem
+    model = SRRegressor(niterations=1, seed=4, **_opts())
+    model.fit(X, y)
+    tex = model.latex()
+    assert isinstance(tex, str) and len(tex) > 0
+    try:
+        import sympy  # noqa: F401
+
+        expr = model.sympy()
+        assert expr is not None
+    except ImportError:
+        pass

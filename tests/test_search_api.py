@@ -1,0 +1,204 @@
+"""End-to-end tests of equation_search and the driver entry points.
+
+Mirrors the reference's evaluation-group tests (test_evaluation.jl,
+test_early_stop.jl, test_migration.jl — SURVEY.md §4): a short search on
+an easy analytic target must drive loss well below the baseline, early
+stopping must trigger, and the multi-chip dry run must compile and run
+on the virtual 8-device CPU mesh.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.api.hall_of_fame import (
+    HallOfFameEntry,
+    calculate_pareto_frontier,
+    compute_scores,
+    load_hall_of_fame_csv,
+    save_hall_of_fame_csv,
+    HallOfFame,
+)
+from symbolicregression_jl_tpu.api.search import equation_search, get_cur_maxsize
+from symbolicregression_jl_tpu.ops.tree import Node, parse_expression, string_tree
+
+
+def small_options(**kw):
+    defaults = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        maxsize=12,
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=20,
+        tournament_selection_n=6,
+        save_to_file=False,
+    )
+    defaults.update(kw)
+    return Options(**defaults)
+
+
+@pytest.fixture(scope="module")
+def linear_problem():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (128, 2)).astype(np.float32)
+    y = 2.0 * X[:, 0] + X[:, 1]
+    return X, y
+
+
+def test_search_improves_over_baseline(linear_problem):
+    X, y = linear_problem
+    hof = equation_search(
+        X, y, options=small_options(), niterations=3, seed=1, verbosity=0
+    )
+    frontier = hof.pareto_frontier()
+    assert len(frontier) >= 1
+    best = min(e.loss for e in frontier)
+    baseline = float(np.var(y))
+    assert best < 0.5 * baseline  # must strongly beat the constant predictor
+
+
+def test_search_early_stop(linear_problem):
+    X, y = linear_problem
+    # Huge threshold: any first iteration already satisfies it.
+    hof = equation_search(
+        X, y,
+        options=small_options(early_stop_condition=1e6),
+        niterations=50, seed=2, verbosity=0,
+    )
+    assert len(hof.entries) >= 1
+
+
+def test_search_return_state_and_warm_start(linear_problem):
+    X, y = linear_problem
+    opts = small_options()
+    state, hof = equation_search(
+        X, y, options=opts, niterations=2, seed=3, verbosity=0,
+        return_state=True,
+    )
+    best1 = min(e.loss for e in hof.entries)
+    state2, hof2 = equation_search(
+        X, y, options=opts, niterations=2, seed=4, verbosity=0,
+        saved_state=state, return_state=True,
+    )
+    best2 = min(e.loss for e in hof2.entries)
+    assert best2 <= best1 + 1e-6  # warm start can only improve the HoF
+
+
+def test_warm_start_rejects_incompatible_options(linear_problem):
+    X, y = linear_problem
+    state, _ = equation_search(
+        X, y, options=small_options(), niterations=1, seed=5, verbosity=0,
+        return_state=True,
+    )
+    with pytest.raises(ValueError, match="maxsize"):
+        equation_search(
+            X, y, options=small_options(maxsize=20), niterations=1,
+            verbosity=0, saved_state=state,
+        )
+
+
+def test_multioutput_search(linear_problem):
+    X, _ = linear_problem
+    Y = np.stack([X[:, 0] * 2.0, X[:, 1] - 1.0])
+    hofs = equation_search(
+        X, Y, options=small_options(), niterations=2, seed=6, verbosity=0
+    )
+    assert isinstance(hofs, list) and len(hofs) == 2
+    for h in hofs:
+        assert len(h.entries) >= 1
+
+
+def test_guess_seeding_injects_solution(linear_problem):
+    X, y = linear_problem
+    opts = small_options()
+    hof = equation_search(
+        X, y, options=opts, niterations=1, seed=7, verbosity=0,
+        guesses=["2.0 * x1 + x2"],
+    )
+    best = min(e.loss for e in hof.entries)
+    assert best < 1e-6  # exact solution seeded
+
+
+def test_initial_population(linear_problem):
+    X, y = linear_problem
+    hof = equation_search(
+        X, y, options=small_options(), niterations=1, seed=8, verbosity=0,
+        initial_population=["x1 + x2", "x1 * x2", "cos(x1)"],
+    )
+    assert len(hof.entries) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Hall of fame host logic
+# ---------------------------------------------------------------------------
+
+
+def _entry(c, loss):
+    return HallOfFameEntry(tree=Node.const(1.0), loss=loss, cost=loss, complexity=c)
+
+
+def test_pareto_frontier_dominance():
+    entries = [_entry(1, 1.0), _entry(2, 2.0), _entry(3, 0.5), _entry(4, 0.4)]
+    frontier = calculate_pareto_frontier(entries)
+    assert [e.complexity for e in frontier] == [1, 3, 4]
+
+
+def test_scores_log_scale():
+    frontier = [_entry(1, 1.0), _entry(3, np.exp(-2.0))]
+    scored = compute_scores(frontier, "log")
+    assert scored[0].score == 0.0
+    assert scored[1].score == pytest.approx(1.0)  # -(-2 - 0)/2
+
+
+def test_hof_csv_roundtrip(tmp_path):
+    opts = small_options()
+    e1 = HallOfFameEntry(
+        tree=parse_expression("2.0 * x1 + cos(x2)", opts.operators),
+        loss=0.5, cost=0.5, complexity=6,
+    )
+    hof = HallOfFame(entries=[e1])
+    path = str(tmp_path / "hall_of_fame.csv")
+    save_hall_of_fame_csv(path, hof, opts.operators)
+    trees = load_hall_of_fame_csv(path, opts.operators)
+    assert len(trees) == 1
+    assert string_tree(trees[0]) == string_tree(e1.tree)
+
+
+def test_cur_maxsize_warmup():
+    # ramp 3 -> maxsize over first half of cycles
+    assert get_cur_maxsize(20, 0.5, 100, 100) == 3
+    assert get_cur_maxsize(20, 0.5, 100, 50) == 20
+    assert get_cur_maxsize(20, 0.5, 100, 75) == 11
+    assert get_cur_maxsize(20, 0.0, 100, 100) == 20
+
+
+# ---------------------------------------------------------------------------
+# Driver entry points on the virtual multi-device CPU mesh
+# ---------------------------------------------------------------------------
+
+
+def test_dryrun_multichip_8_devices():
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import __graft_entry__ as ge
+
+    n = min(8, len(jax.devices()))
+    ge.dryrun_multichip(n)
+
+
+def test_entry_compiles():
+    import jax
+
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (256,)
+    assert bool(np.isfinite(np.asarray(out)).any())
