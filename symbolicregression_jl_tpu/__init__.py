@@ -37,6 +37,16 @@ def __getattr__(name):
         from .api import search
 
         return getattr(search, name)
+    if name in (
+        "eval_tree_array",
+        "eval_diff_tree_array",
+        "eval_grad_tree_array",
+        "differentiable_eval_tree_array",
+        "D",
+    ):
+        from .ops import diff
+
+        return getattr(diff, name)
     if name in ("SRRegressor", "MultitargetSRRegressor"):
         from .api import regressor
 

@@ -151,26 +151,43 @@ class SRRegressor:
         if category is not None:
             extra = {"class": np.asarray(category)}
 
+        # Warm-start refits run only the *delta* iterations
+        # (src/MLJInterface.jl:292-294): fitting twice with the same
+        # niterations runs no extra work; raising niterations runs the
+        # difference.
+        niterations = self.niterations
+        if saved_state is not None:
+            niterations = max(self.niterations - self.fitted_iterations_, 0)
+        if saved_state is not None and niterations == 0:
+            self._build_report()
+            return self
+
+        ropt = RuntimeOptions(
+            niterations=niterations,
+            devices=self.devices,
+            n_data_shards=self.n_data_shards,
+            verbosity=self.verbosity,
+            progress=self.progress,
+            seed=self.seed,
+            return_state=True,
+        )
+        if self.run_id is not None:
+            ropt.run_id = self.run_id
         state, hof = equation_search(
             X,
             y_internal,
             options=new_options,
-            niterations=self.niterations,
             weights=weights,
             variable_names=variable_names,
             X_units=X_units,
             y_units=y_units,
             extra=extra,
             saved_state=saved_state,
-            verbosity=self.verbosity,
-            progress=self.progress,
-            run_id=self.run_id,
-            seed=self.seed,
-            return_state=True,
+            runtime_options=ropt,
         )
         self.state_ = state
         self.hofs_ = hof if isinstance(hof, list) else [hof]
-        self.fitted_iterations_ += self.niterations
+        self.fitted_iterations_ += niterations
         self._build_report()
         return self
 
@@ -241,11 +258,12 @@ class SRRegressor:
         self._check_fitted()
         X = np.asarray(X)
         if self._MULTITARGET:
-            idxs = (
-                list(idx)
-                if idx is not None
-                else list(self.best_idx_)
-            )
+            if idx is None:
+                idxs = list(self.best_idx_)
+            elif np.ndim(idx) == 0:
+                idxs = [int(idx)] * len(self.equations_)
+            else:
+                idxs = list(idx)
             outs = [
                 self._predict_one(recs, i, X)
                 for recs, i in zip(self.equations_, idxs)

@@ -28,6 +28,8 @@ from ..evolve.engine import Engine, SearchDeviceState
 from ..ops.encoding import TreeBatch, encode_population
 from ..ops.tree import Node, parse_expression
 from ..parallel.mesh import make_mesh, shard_device_data, shard_search_state
+from ..utils.progress import ProgressBar
+from ..utils.recorder import Recorder
 from .hall_of_fame import (
     HallOfFame,
     save_hall_of_fame_csv,
@@ -259,15 +261,21 @@ def equation_search(
     """
     options = options or Options()
     ropt = runtime_options or RuntimeOptions(niterations=niterations)
-    if runtime_options is None:
-        if verbosity is not None:
-            ropt.verbosity = verbosity
-        if progress is not None:
-            ropt.progress = progress
-        if run_id is not None:
-            ropt.run_id = run_id
-        ropt.return_state = return_state
-        ropt.seed = seed if seed is not None else options.seed
+    # Explicit kwargs override the RuntimeOptions fields either way — a
+    # caller passing both runtime_options and e.g. seed=42 must not have
+    # the seed silently dropped.
+    if verbosity is not None:
+        ropt.verbosity = verbosity
+    if progress is not None:
+        ropt.progress = progress
+    if run_id is not None:
+        ropt.run_id = run_id
+    if return_state:
+        ropt.return_state = True
+    if seed is not None:
+        ropt.seed = seed
+    elif ropt.seed is None:
+        ropt.seed = options.seed
 
     datasets = _resolve_datasets(
         X, y, weights, variable_names, display_variable_names,
@@ -346,6 +354,8 @@ def equation_search(
     num_evals0 = saved_state.num_evals if saved_state is not None else 0.0
     stop_reason = None
     cycles_remaining = total_cycles
+    recorder = Recorder(options) if options.use_recorder else None
+    bar = ProgressBar(ropt.niterations) if ropt.progress else None
 
     it = 0
     while it < ropt.niterations and stop_reason is None:
@@ -375,22 +385,33 @@ def equation_search(
                     variable_names=ds.variable_names,
                 )
 
+        if recorder is not None:
+            for j, ds in enumerate(datasets):
+                recorder.record_iteration(
+                    it, j, states[j], hofs[j], float(states[j].num_evals),
+                    variable_names=ds.variable_names,
+                )
+
         if ropt.logger is not None and it % max(ropt.log_every_n, 1) == 0:
             ropt.logger.log_iteration(
                 iteration=it, hofs=hofs, states=states, options=options,
                 num_evals=total_evals, elapsed=time.time() - start_time,
             )
 
-        if ropt.verbosity >= 2 or (ropt.progress and ropt.verbosity >= 1):
+        if bar is not None or ropt.verbosity >= 2:
             elapsed = time.time() - start_time
             best_loss = min(
                 (e.loss for h in hofs for e in h.entries), default=np.inf
             )
-            print(
-                f"[iter {it}/{ropt.niterations}] best_loss={best_loss:.6g} "
-                f"evals={total_evals:.3g} "
-                f"({total_evals / max(elapsed, 1e-9):.3g}/s)"
-            )
+            rate = total_evals / max(elapsed, 1e-9)
+            if bar is not None:
+                bar.update(it, best_loss=best_loss, evals_per_sec=rate)
+            if ropt.verbosity >= 2:
+                print(
+                    f"[iter {it}/{ropt.niterations}] "
+                    f"best_loss={best_loss:.6g} evals={total_evals:.3g} "
+                    f"({rate:.3g}/s)"
+                )
 
         # ---- early stopping (src/SearchUtils.jl:387-409) ----
         if options.early_stop_condition is not None:
@@ -408,6 +429,20 @@ def equation_search(
             stop_reason = "timeout"
         if options.max_evals is not None and total_evals >= options.max_evals:
             stop_reason = "max_evals"
+
+    if bar is not None:
+        bar.close()
+    if recorder is not None:
+        recorder.record_final("stop_reason", stop_reason or "niterations")
+        recorder.record_final(
+            "num_evals", num_evals0 + sum(float(s.num_evals) for s in states)
+        )
+        rec_path = (
+            os.path.join(out_dir, options.recorder_file)
+            if out_dir is not None
+            else options.recorder_file
+        )
+        recorder.write(rec_path)
 
     if ropt.verbosity >= 1:
         for j, (hof, ds) in enumerate(zip(hofs, datasets)):

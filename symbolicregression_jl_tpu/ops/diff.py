@@ -1,0 +1,374 @@
+"""Public evaluation + differentiation API over expression trees.
+
+TPU-native equivalents of the reference's DynamicExpressions surface
+(imported at /root/reference/src/SymbolicRegression.jl:101-144 and wrapped
+at /root/reference/src/InterfaceDynamicExpressions.jl:58-183):
+
+- ``eval_tree_array``      — evaluate one host tree over a dataset.
+- ``eval_diff_tree_array`` — forward-mode derivative w.r.t. one feature.
+- ``eval_grad_tree_array`` — gradient w.r.t. all features or all constants.
+- ``differentiable_eval_tree_array`` — alias; the interpreter is natively
+  differentiable (``jax.grad`` flows through it), which replaces the
+  reference's dedicated differentiable evaluator
+  (src/InterfaceDynamicExpressions.jl:172-183).
+- ``D``                    — symbolic differentiation operator on host
+  trees (the DynamicDiff.D analogue used by template structures,
+  /root/reference/src/SymbolicRegression.jl:172).
+
+Derivatives are computed by ``jax.jvp``/``jax.jacfwd`` through the postfix
+interpreter — no hand-written tree differentiator on the eval path. The
+symbolic ``D`` exists for the template-structure API where a *tree-valued*
+derivative is required.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encoding import LEAF_CONST, encode_tree, tree_structure_arrays, TreeBatch
+from .eval import eval_single_tree
+from .operators import Op, OperatorSet, resolve_operator
+from .tree import Node
+
+__all__ = [
+    "eval_tree_array",
+    "eval_diff_tree_array",
+    "eval_grad_tree_array",
+    "differentiable_eval_tree_array",
+    "D",
+]
+
+
+def _as_xt(X) -> jax.Array:
+    """User arrays are (n_rows, n_features); the interpreter wants [F, n]."""
+    X = jnp.asarray(X)
+    if X.ndim != 2:
+        raise ValueError(f"X must be 2D (n_rows, n_features); got {X.shape}")
+    return X.T
+
+
+def extend_operators_for(tree: Node, operators: OperatorSet) -> OperatorSet:
+    """Extend ``operators`` with any ops used by ``tree`` but absent from
+    the set. Symbolic derivatives (``D``) introduce helper operators
+    (``neg``, ``sign``, comparison ops, …) outside the search vocabulary;
+    evaluation transparently widens the operator tables for them."""
+    have = {(d, o.name) for d, ops in operators.ops.items() for o in ops}
+    extra = {}
+    for n in tree.nodes():
+        if n.degree > 0 and (n.degree, n.op.name) not in have:
+            extra[(n.degree, n.op.name)] = n.op
+    if not extra:
+        return operators
+    ops_by_arity = {d: list(ops) for d, ops in operators.ops.items()}
+    for (d, _), op in extra.items():
+        ops_by_arity.setdefault(d, []).append(op)
+    return OperatorSet(ops_by_arity={d: tuple(v) for d, v in ops_by_arity.items()})
+
+
+def _encode_single(tree: Node, operators: OperatorSet, dtype):
+    n_nodes = tree.count_nodes()
+    arity, op, feat, const, length = encode_tree(
+        tree, n_nodes, operators, dtype
+    )
+    batch = TreeBatch(
+        arity=jnp.asarray(arity)[None],
+        op=jnp.asarray(op)[None],
+        feat=jnp.asarray(feat)[None],
+        const=jnp.asarray(const)[None],
+        length=jnp.asarray(length)[None],
+    )
+    child, _, _ = tree_structure_arrays(batch)
+    return (
+        batch.arity[0], batch.op[0], batch.feat[0], batch.const[0],
+        batch.length[0], child[0],
+    )
+
+
+def eval_tree_array(
+    tree: Node,
+    X,
+    operators: OperatorSet,
+    params: Optional[jax.Array] = None,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array]:
+    """Evaluate ``tree`` over ``X`` (n_rows, n_features).
+
+    Returns ``(y[n_rows], completed)`` where ``completed`` is False iff a
+    non-finite value appeared anywhere in the evaluation (the reference's
+    early-exit flag, src/InterfaceDynamicExpressions.jl:32-44).
+    """
+    Xt = _as_xt(X).astype(dtype)
+    operators = extend_operators_for(tree, operators)
+    a, o, f, c, ln, ch = _encode_single(tree, operators, np.dtype(dtype))
+    y, valid = eval_single_tree(a, o, f, c, ln, ch, Xt, operators, params=params)
+    return y, valid
+
+
+def eval_diff_tree_array(
+    tree: Node,
+    X,
+    operators: OperatorSet,
+    direction: int,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Forward-mode derivative w.r.t. feature ``direction`` (0-based).
+
+    Returns ``(y[n], dy_dx[n], completed)`` — the
+    ``eval_diff_tree_array`` analogue
+    (src/InterfaceDynamicExpressions.jl:118-130).
+    """
+    Xt = _as_xt(X).astype(dtype)
+    operators = extend_operators_for(tree, operators)
+    a, o, f, c, ln, ch = _encode_single(tree, operators, np.dtype(dtype))
+
+    def run(Xt_):
+        y, valid = eval_single_tree(a, o, f, c, ln, ch, Xt_, operators)
+        return y, valid
+
+    seed = jnp.zeros_like(Xt).at[direction].set(1.0)
+    (y, valid), (dy, _) = jax.jvp(run, (Xt,), (seed,))
+    return y, dy, valid
+
+
+def eval_grad_tree_array(
+    tree: Node,
+    X,
+    operators: OperatorSet,
+    variable: bool = False,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Gradient of the tree's output at every row.
+
+    ``variable=True``: w.r.t. all features — returns grad ``[F, n]``.
+    ``variable=False``: w.r.t. the tree's scalar constants in depth-first
+    post-order — returns grad ``[n_constants, n]``. Mirrors
+    ``eval_grad_tree_array`` (src/InterfaceDynamicExpressions.jl:153-165).
+    """
+    Xt = _as_xt(X).astype(dtype)
+    operators = extend_operators_for(tree, operators)
+    a, o, f, c, ln, ch = _encode_single(tree, operators, np.dtype(dtype))
+
+    if variable:
+        def run(Xt_):
+            y, _ = eval_single_tree(a, o, f, c, ln, ch, Xt_, operators)
+            return y
+
+        y, valid = eval_single_tree(a, o, f, c, ln, ch, Xt, operators)
+        # One JVP per feature: dy_i/dX[f, i] (diagonal of the per-row
+        # Jacobian — each output row only depends on its own input row).
+        def per_feature(fidx):
+            seed = jnp.zeros_like(Xt).at[fidx].set(1.0)
+            _, dy = jax.jvp(run, (Xt,), (seed,))
+            return dy
+
+        grad = jax.vmap(per_feature)(jnp.arange(Xt.shape[0]))
+        return y, grad, valid
+
+    # w.r.t. constants: differentiate the const slot vector, then gather
+    # the rows belonging to actual constant leaves.
+    const_slots = np.asarray(
+        [k for k, n in enumerate(tree.nodes())
+         if n.degree == 0 and n.constant and not n.is_parameter]
+    )
+
+    def run_c(c_):
+        y, _ = eval_single_tree(a, o, f, c_, ln, ch, Xt, operators)
+        return y
+
+    y, valid = eval_single_tree(a, o, f, c, ln, ch, Xt, operators)
+    if const_slots.size == 0:
+        return y, jnp.zeros((0, Xt.shape[1]), Xt.dtype), valid
+    jac = jax.jacfwd(run_c)(c)  # [n, L]
+    grad = jac.T[const_slots]   # [n_constants, n]
+    return y, grad, valid
+
+
+# The interpreter is pure JAX: it IS the differentiable evaluator.
+differentiable_eval_tree_array = eval_tree_array
+
+
+# ---------------------------------------------------------------------------
+# Symbolic differentiation (DynamicDiff.D analogue)
+# ---------------------------------------------------------------------------
+
+
+def _op(name: str) -> Op:
+    return resolve_operator(name)
+
+
+def _c(v: float) -> Node:
+    return Node.const(float(v))
+
+
+def _is_const(n: Node, v: Optional[float] = None) -> bool:
+    return (
+        n.degree == 0 and n.constant and not n.is_parameter
+        and (v is None or n.val == v)
+    )
+
+
+def _add(a: Node, b: Node) -> Node:
+    if _is_const(a, 0.0):
+        return b
+    if _is_const(b, 0.0):
+        return a
+    if _is_const(a) and _is_const(b):
+        return _c(a.val + b.val)
+    return Node(op=_op("+"), children=[a, b])
+
+
+def _sub(a: Node, b: Node) -> Node:
+    if _is_const(b, 0.0):
+        return a
+    if _is_const(a) and _is_const(b):
+        return _c(a.val - b.val)
+    if _is_const(a, 0.0):
+        return Node(op=_op("neg"), children=[b])
+    return Node(op=_op("-"), children=[a, b])
+
+
+def _mul(a: Node, b: Node) -> Node:
+    if _is_const(a, 0.0) or _is_const(b, 0.0):
+        return _c(0.0)
+    if _is_const(a, 1.0):
+        return b
+    if _is_const(b, 1.0):
+        return a
+    if _is_const(a) and _is_const(b):
+        return _c(a.val * b.val)
+    return Node(op=_op("*"), children=[a, b])
+
+
+def _div(a: Node, b: Node) -> Node:
+    if _is_const(a, 0.0):
+        return _c(0.0)
+    if _is_const(b, 1.0):
+        return a
+    if _is_const(a) and _is_const(b) and b.val != 0:
+        return _c(a.val / b.val)
+    return Node(op=_op("/"), children=[a, b])
+
+
+def _pow(a: Node, b: Node) -> Node:
+    if _is_const(b, 1.0):
+        return a
+    if _is_const(b, 0.0):
+        return _c(1.0)
+    return Node(op=_op("^"), children=[a, b])
+
+
+def _un(name: str, a: Node) -> Node:
+    return Node(op=_op(name), children=[a])
+
+
+def D(tree: Node, feature: int) -> Node:
+    """Symbolic derivative of ``tree`` w.r.t. variable ``feature`` (0-based).
+
+    Returns a new tree (inputs are not mutated). Supports the operator
+    vocabulary of the builtin registry; raises ``ValueError`` for operators
+    with no registered derivative rule. The result is lightly simplified
+    (constant folding, 0/1 identities) so that iterated application stays
+    compact — the behavior template structures rely on when using the
+    reference's ``D`` (src/SymbolicRegression.jl:172).
+    """
+    if tree.degree == 0:
+        if tree.is_parameter or tree.constant:
+            return _c(0.0)
+        return _c(1.0 if tree.feature == feature else 0.0)
+
+    name = tree.op.name
+    if tree.degree == 2:
+        a, b = tree.children
+        da, db = D(a, feature), D(b, feature)
+        ac, bc = a.copy(), b.copy()
+        if name == "+":
+            return _add(da, db)
+        if name == "-":
+            return _sub(da, db)
+        if name == "*":
+            return _add(_mul(da, bc), _mul(ac, db))
+        if name == "/":
+            return _div(
+                _sub(_mul(da, bc), _mul(ac, db)), _mul(b.copy(), b.copy())
+            )
+        if name == "^":
+            # d(a^b) = a^b * (db*log(a) + b*da/a)
+            term1 = _mul(db, _un("log", ac))
+            term2 = _div(_mul(bc, da), a.copy())
+            return _mul(_pow(a.copy(), b.copy()), _add(term1, term2))
+        if name == "max":
+            ge = Node(op=_op("greater_equal"), children=[ac, bc])
+            one_minus = _sub(_c(1.0), ge.copy())
+            return _add(_mul(ge, da), _mul(one_minus, db))
+        if name == "min":
+            le = Node(op=_op("less_equal"), children=[ac, bc])
+            one_minus = _sub(_c(1.0), le.copy())
+            return _add(_mul(le, da), _mul(one_minus, db))
+        if name == "atan2":
+            denom = _add(_mul(a.copy(), a.copy()), _mul(b.copy(), b.copy()))
+            return _div(_sub(_mul(bc, da), _mul(ac, db)), denom)
+        raise ValueError(f"No derivative rule for binary operator {name!r}")
+
+    (a,) = tree.children
+    da = D(a, feature)
+    ac = a.copy()
+    rules = {
+        "sin": lambda: _un("cos", ac),
+        "cos": lambda: _un("neg", _un("sin", ac)),
+        "tan": lambda: _add(_c(1.0), _mul(_un("tan", ac), _un("tan", a.copy()))),
+        "sinh": lambda: _un("cosh", ac),
+        "cosh": lambda: _un("sinh", ac),
+        "tanh": lambda: _sub(
+            _c(1.0), _mul(_un("tanh", ac), _un("tanh", a.copy()))
+        ),
+        "exp": lambda: _un("exp", ac),
+        "log": lambda: _div(_c(1.0), ac),
+        "log2": lambda: _div(_c(1.0 / np.log(2.0)), ac),
+        "log10": lambda: _div(_c(1.0 / np.log(10.0)), ac),
+        "log1p": lambda: _div(_c(1.0), _add(_c(1.0), ac)),
+        "sqrt": lambda: _div(_c(0.5), _un("sqrt", ac)),
+        "cbrt": lambda: _div(
+            _c(1.0 / 3.0), _mul(_un("cbrt", ac), _un("cbrt", a.copy()))
+        ),
+        "abs": lambda: _un("sign", ac),
+        "neg": lambda: _c(-1.0),
+        "square": lambda: _mul(_c(2.0), ac),
+        "cube": lambda: _mul(_c(3.0), _mul(ac, a.copy())),
+        "inv": lambda: _un("neg", _div(_c(1.0), _mul(ac, a.copy()))),
+        "asin": lambda: _div(
+            _c(1.0), _un("sqrt", _sub(_c(1.0), _mul(ac, a.copy())))
+        ),
+        "acos": lambda: _un(
+            "neg",
+            _div(_c(1.0), _un("sqrt", _sub(_c(1.0), _mul(ac, a.copy())))),
+        ),
+        "atan": lambda: _div(_c(1.0), _add(_c(1.0), _mul(ac, a.copy()))),
+        "asinh": lambda: _div(
+            _c(1.0), _un("sqrt", _add(_c(1.0), _mul(ac, a.copy())))
+        ),
+        "acosh": lambda: _div(
+            _c(1.0), _un("sqrt", _sub(_mul(ac, a.copy()), _c(1.0)))
+        ),
+        "atanh": lambda: _div(_c(1.0), _sub(_c(1.0), _mul(ac, a.copy()))),
+        "erf": lambda: _mul(
+            _c(2.0 / np.sqrt(np.pi)),
+            _un("exp", _un("neg", _mul(ac, a.copy()))),
+        ),
+        "erfc": lambda: _mul(
+            _c(-2.0 / np.sqrt(np.pi)),
+            _un("exp", _un("neg", _mul(ac, a.copy()))),
+        ),
+        "relu": lambda: Node(op=_op("greater"), children=[ac, _c(0.0)]),
+        "sign": lambda: _c(0.0),
+        "round": lambda: _c(0.0),
+        "floor": lambda: _c(0.0),
+        "ceil": lambda: _c(0.0),
+    }
+    if name not in rules:
+        raise ValueError(f"No derivative rule for unary operator {name!r}")
+    outer = rules[name]()
+    return _mul(outer, da)

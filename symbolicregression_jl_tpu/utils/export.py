@@ -174,22 +174,30 @@ def to_sympy(tree: Node, variable_names: Optional[Sequence[str]] = None):
 def to_callable(
     tree: Node, variable_names: Optional[Sequence[str]] = None
 ) -> Callable:
-    """Build a vectorized numpy callable ``f(X: (n, nfeatures)) -> (n,)``."""
+    """Build a vectorized callable ``f(X: (n, nfeatures), params=None) -> (n,)``.
 
-    def f(X):
-        X = np.asarray(X, dtype=np.float64)
+    Computation runs through the operator table's JAX functions (float32,
+    the framework's eval precision). Parameter leaves read from ``params``
+    (a 1D vector); calling a parametric tree without ``params`` raises.
+    """
+
+    def f(X, params=None):
+        X = np.asarray(X, dtype=np.float32)
 
         def go(n: Node):
             if n.degree == 0:
+                if n.is_parameter:
+                    if params is None:
+                        raise ValueError(
+                            "Tree contains parameter leaves; pass `params`."
+                        )
+                    return np.full(X.shape[0], params[n.parameter], np.float32)
                 if n.constant:
-                    return np.full(X.shape[0], n.val)
+                    return np.full(X.shape[0], n.val, np.float32)
                 return X[:, n.feature]
             args = [go(c) for c in n.children]
             with np.errstate(all="ignore"):
-                import jax
-
-                out = n.op.fn(*[a.astype(np.float32) for a in args])
-                return np.asarray(out, dtype=np.float64)
+                return np.asarray(n.op.fn(*args), dtype=np.float32)
 
         return go(tree)
 

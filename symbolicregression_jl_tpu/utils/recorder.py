@@ -1,0 +1,103 @@
+"""Search genealogy recorder.
+
+The Recorder analogue (/root/reference/src/Recorder.jl +
+ext/SymbolicRegressionJSON3Ext.jl): when ``options.use_recorder`` is set,
+the search accumulates a JSON-serializable record of the run and writes it
+to ``options.recorder_file`` at teardown
+(src/SymbolicRegression.jl:1231).
+
+Granularity note: the reference logs every mutation/death event from its
+sequential per-member loop (src/RegularizedEvolution.jl:47-149). Here the
+whole generation runs inside one XLA program, so per-event host logging
+would serialize the device; instead the recorder snapshots the lineage
+state (ref/parent ids, costs, losses, complexities) of every island member
+once per iteration — the ref/parent chains reconstruct the same genealogy
+DAG — plus the full hall of fame with equation strings.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.tree import string_tree
+
+__all__ = ["Recorder"]
+
+
+def _sanitize(v):
+    if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+        return str(v)
+    return v
+
+
+class Recorder:
+    """Accumulates RecordType-style nested dicts (src/ProgramConstants.jl)."""
+
+    def __init__(self, options) -> None:
+        self.record: Dict[str, Any] = {
+            "options": repr(options),
+            "iterations": [],
+            "final_state": {},
+        }
+
+    def record_iteration(
+        self,
+        iteration: int,
+        out_idx: int,
+        state,
+        hof,
+        num_evals: float,
+        variable_names: Optional[Sequence[str]] = None,
+    ) -> None:
+        pops = state.pops
+        ref = np.asarray(pops.ref)
+        parent = np.asarray(pops.parent)
+        cost = np.asarray(pops.cost, np.float64)
+        loss = np.asarray(pops.loss, np.float64)
+        cx = np.asarray(pops.complexity)
+        birth = np.asarray(pops.birth)
+        islands: List[Dict[str, Any]] = []
+        for i in range(ref.shape[0]):
+            islands.append(
+                {
+                    "ref": ref[i].tolist(),
+                    "parent": parent[i].tolist(),
+                    "cost": [_sanitize(float(c)) for c in cost[i]],
+                    "loss": [_sanitize(float(c)) for c in loss[i]],
+                    "complexity": cx[i].tolist(),
+                    "birth": birth[i].tolist(),
+                }
+            )
+        self.record["iterations"].append(
+            {
+                "iteration": iteration,
+                "out": out_idx + 1,
+                "num_evals": float(num_evals),
+                "islands": islands,
+                "hall_of_fame": [
+                    {
+                        "complexity": int(e.complexity),
+                        "loss": _sanitize(float(e.loss)),
+                        "equation": string_tree(
+                            e.tree, variable_names=variable_names
+                        ),
+                    }
+                    for e in hof.entries
+                ],
+            }
+        )
+
+    def record_final(self, key: str, value: Any) -> None:
+        self.record["final_state"][key] = value
+
+    def write(self, path: str) -> None:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.record, f)

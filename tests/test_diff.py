@@ -1,0 +1,126 @@
+"""Tests for the public eval/diff API and the symbolic D operator.
+
+Mirrors the reference's AD integration tests
+(/root/reference/test/integration/ad/) at unit scale: forward derivatives,
+constant gradients, and symbolic differentiation golden values.
+"""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import (
+    Node,
+    OperatorSet,
+    parse_expression,
+)
+from symbolicregression_jl_tpu.ops.diff import (
+    D,
+    eval_diff_tree_array,
+    eval_grad_tree_array,
+    eval_tree_array,
+)
+
+OPS = OperatorSet(
+    binary_operators=["+", "-", "*", "/", "^"],
+    unary_operators=["sin", "cos", "exp", "log", "sqrt", "abs"],
+)
+
+
+@pytest.fixture(scope="module")
+def X():
+    rng = np.random.default_rng(0)
+    return rng.uniform(0.5, 2.0, (64, 3)).astype(np.float32)
+
+
+def _parse(s):
+    return parse_expression(s, OPS, variable_names=["x1", "x2", "x3"])
+
+
+def test_eval_tree_array_golden(X):
+    tree = _parse("2.0 * x1 + cos(x2)")
+    y, valid = eval_tree_array(tree, X, OPS)
+    np.testing.assert_allclose(
+        np.asarray(y), 2.0 * X[:, 0] + np.cos(X[:, 1]), rtol=1e-5
+    )
+    assert bool(valid)
+
+
+def test_eval_tree_array_invalid(X):
+    tree = _parse("log(x1 - 5.0)")  # all rows < 5 => NaN domain
+    _, valid = eval_tree_array(tree, X, OPS)
+    assert not bool(valid)
+
+
+def test_eval_diff_tree_array(X):
+    tree = _parse("sin(x1 * x2) + x3")
+    y, dy, valid = eval_diff_tree_array(tree, X, OPS, direction=0)
+    expected = np.cos(X[:, 0] * X[:, 1]) * X[:, 1]
+    np.testing.assert_allclose(np.asarray(dy), expected, rtol=1e-4, atol=1e-5)
+    assert bool(valid)
+
+
+def test_eval_grad_tree_array_variables(X):
+    tree = _parse("x1 * x2 + exp(x3)")
+    y, grad, valid = eval_grad_tree_array(tree, X, OPS, variable=True)
+    assert grad.shape == (3, X.shape[0])
+    np.testing.assert_allclose(np.asarray(grad[0]), X[:, 1], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad[1]), X[:, 0], rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grad[2]), np.exp(X[:, 2]), rtol=1e-4
+    )
+
+
+def test_eval_grad_tree_array_constants(X):
+    tree = _parse("3.0 * x1 + 1.5")
+    y, grad, valid = eval_grad_tree_array(tree, X, OPS, variable=False)
+    # Constants in postfix order: 3.0 then 1.5.
+    assert grad.shape == (2, X.shape[0])
+    np.testing.assert_allclose(np.asarray(grad[0]), X[:, 0], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad[1]), 1.0, rtol=1e-5)
+
+
+def test_eval_grad_no_constants(X):
+    tree = _parse("x1 + x2")
+    _, grad, _ = eval_grad_tree_array(tree, X, OPS, variable=False)
+    assert grad.shape == (0, X.shape[0])
+
+
+@pytest.mark.parametrize(
+    "expr,feature",
+    [
+        ("sin(x1 * x2)", 0),
+        ("exp(x1) / x2", 1),
+        ("sqrt(x1) + x1 ^ 3.0", 0),
+        ("log(x2 * x2)", 1),
+        ("abs(x1 - x3)", 2),
+    ],
+)
+def test_D_matches_jvp(X, expr, feature):
+    """Symbolic derivative evaluates identically to forward-mode AD."""
+    tree = _parse(expr)
+    dtree = D(tree, feature)
+    y_sym, valid_sym = eval_tree_array(dtree, X, OPS)
+    _, dy_ad, _ = eval_diff_tree_array(tree, X, OPS, direction=feature)
+    np.testing.assert_allclose(
+        np.asarray(y_sym), np.asarray(dy_ad), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_D_of_constant_is_zero():
+    assert D(Node.const(3.0), 0).val == 0.0
+    assert D(Node.var(1), 0).val == 0.0
+    assert D(Node.var(0), 0).val == 1.0
+
+
+def test_D_simplifies():
+    # d/dx1 (x1 + 5) = 1 exactly, as a single constant node.
+    tree = _parse("x1 + 5.0")
+    d = D(tree, 0)
+    assert d.degree == 0 and d.val == 1.0
+
+
+def test_D_unknown_operator_raises():
+    ops = OperatorSet(binary_operators=["+"], unary_operators=["gamma"])
+    tree = parse_expression("gamma(x1)", ops, variable_names=["x1"])
+    with pytest.raises(ValueError):
+        D(tree, 0)

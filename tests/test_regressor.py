@@ -65,11 +65,17 @@ def test_unfitted_raises(problem):
 
 
 def test_warm_start_refit_continues(problem):
+    """Warm-start refits run only the delta iterations
+    (src/MLJInterface.jl:292-294): same niterations => no extra work;
+    raising niterations runs the difference."""
     X, y = problem
     model = SRRegressor(niterations=2, seed=2, **_opts())
     model.fit(X, y)
     loss1 = model.get_best().loss
-    model.fit(X, y)  # warm-start: runs 2 more iterations from saved state
+    model.fit(X, y)  # same niterations: already fitted, runs 0 more
+    assert model.fitted_iterations_ == 2
+    model.niterations = 4
+    model.fit(X, y)  # delta: runs 2 more iterations from saved state
     assert model.fitted_iterations_ == 4
     assert model.get_best().loss <= loss1 + 1e-6
 
