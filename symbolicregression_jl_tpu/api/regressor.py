@@ -187,7 +187,10 @@ class SRRegressor:
         )
         self.state_ = state
         self.hofs_ = hof if isinstance(hof, list) else [hof]
-        self.fitted_iterations_ += niterations
+        if saved_state is None:
+            self.fitted_iterations_ = niterations  # cold fit resets the count
+        else:
+            self.fitted_iterations_ += niterations
         self._build_report()
         return self
 

@@ -260,7 +260,13 @@ def equation_search(
     ``return_state=True``.
     """
     options = options or Options()
-    ropt = runtime_options or RuntimeOptions(niterations=niterations)
+    # Copy so the caller's RuntimeOptions is never mutated (it may be
+    # reused across searches).
+    ropt = (
+        dataclasses.replace(runtime_options)
+        if runtime_options is not None
+        else RuntimeOptions(niterations=niterations)
+    )
     # Explicit kwargs override the RuntimeOptions fields either way — a
     # caller passing both runtime_options and e.g. seed=42 must not have
     # the seed silently dropped.

@@ -38,6 +38,10 @@ class DeviceData:
     class_idx: Optional[jax.Array]  # [n] int32 or None (parametric expressions)
     baseline_loss: jax.Array  # scalar
     use_baseline: jax.Array  # bool scalar
+    # Dimensional analysis (None when the dataset has no units): SI
+    # exponent vectors consumed by ops.dims_eval.
+    x_dims: Optional[jax.Array] = None  # [nfeatures, 7] float32
+    y_dims: Optional[jax.Array] = None  # [7] float32
 
 
 @dataclasses.dataclass
@@ -137,6 +141,8 @@ def make_dataset(
         raise ValueError(f"X must be 2D (n, nfeatures); got shape {X.shape}")
     if dtype is None:
         dtype = X.dtype if X.dtype in (np.float32, np.float64) else np.float32
+    if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
+        dtype = np.float32  # avoid jnp's silent-truncation warning per array
     n, nfeatures = X.shape
     y_arr = None if y is None else np.asarray(y, dtype).reshape(-1)
     if y_arr is not None and y_arr.shape[0] != n:
@@ -163,6 +169,14 @@ def make_dataset(
             else [f"x{_subscriptify(i + 1)}" for i in range(nfeatures)]
         )
     )
+    if X_units is not None and display_variable_names is not None:
+        # Unit-annotated printing (the reference annotates variables with
+        # their units when printing trees,
+        # /root/reference/src/InterfaceDynamicExpressions.jl:199-317).
+        display_variable_names = [
+            f"{name}[{u}]" if u not in (None, "", "1") else name
+            for name, u in zip(display_variable_names, X_units)
+        ]
     if y_variable_name is None:
         y_variable_name = "y" if "y" not in variable_names else "target"
 
@@ -173,6 +187,9 @@ def make_dataset(
         else:
             avg_y = float(np.mean(y_arr))
 
+    from .units import units_to_dims_arrays
+
+    x_dims_np, y_dims_np = units_to_dims_arrays(X_units, nfeatures, y_units)
     data = DeviceData(
         Xt=jnp.asarray(X.T.astype(dtype)),
         y=None if y_arr is None else jnp.asarray(y_arr),
@@ -180,6 +197,8 @@ def make_dataset(
         class_idx=class_idx,
         baseline_loss=jnp.asarray(1.0, dtype),
         use_baseline=jnp.bool_(True),
+        x_dims=None if x_dims_np is None else jnp.asarray(x_dims_np),
+        y_dims=None if y_dims_np is None else jnp.asarray(y_dims_np),
     )
     return Dataset(
         data=data,

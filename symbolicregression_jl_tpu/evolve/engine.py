@@ -109,6 +109,8 @@ class Engine:
                 self.cfg.operators, self.cfg.parsimony,
                 turbo=self.cfg.turbo, interpret=self.cfg.interpret,
                 loss_function=self.options.resolved_loss_function,
+                dim_penalty=self.cfg.dim_penalty,
+                wildcard_constants=self.cfg.wildcard_constants,
             )
         )
 
@@ -137,6 +139,8 @@ class Engine:
                 cfg.operators, cfg.parsimony,
                 turbo=cfg.turbo, interpret=cfg.interpret,
                 loss_function=self.options.resolved_loss_function,
+                dim_penalty=cfg.dim_penalty,
+                wildcard_constants=cfg.wildcard_constants,
             )
         )(trees)
 
@@ -271,6 +275,8 @@ class Engine:
                 t, data, el_loss, tables, cfg.operators, cfg.parsimony,
                 turbo=cfg.turbo, interpret=cfg.interpret,
                 loss_function=options.resolved_loss_function,
+                dim_penalty=cfg.dim_penalty,
+                wildcard_constants=cfg.wildcard_constants,
             )
         )(pops.trees)
         pops = dataclasses.replace(pops, cost=cost, loss=loss, complexity=cx)

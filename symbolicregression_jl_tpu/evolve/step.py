@@ -75,6 +75,10 @@ class EvolveConfig(NamedTuple):
     batch_size: int
     turbo: bool        # use the fused Pallas eval kernel
     interpret: bool    # pallas interpret mode (non-TPU backends)
+    # Dimensional analysis: cost penalty for unit violations (applied only
+    # when the dataset carries units), and whether constants are wildcards.
+    dim_penalty: float = 1000.0
+    wildcard_constants: bool = True
 
     @property
     def n_slots(self) -> int:
@@ -125,6 +129,12 @@ def evolve_config_from_options(options: Options, nfeatures: int) -> EvolveConfig
         batch_size=options.batch_size,
         turbo=turbo,
         interpret=not on_tpu,
+        dim_penalty=(
+            options.dimensional_constraint_penalty
+            if options.dimensional_constraint_penalty is not None
+            else 1000.0  # src/LossFunctions.jl:236-245 default
+        ),
+        wildcard_constants=not options.dimensionless_constants_only,
     )
 
 
@@ -240,7 +250,8 @@ def _check_single(tree: TreeBatch, options, tables, cur_maxsize):
 
 def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
                     operators, parsimony, batch_idx=None, params=None,
-                    turbo=False, interpret=False, loss_function=None):
+                    turbo=False, interpret=False, loss_function=None,
+                    dim_penalty=1000.0, wildcard_constants=True):
     """Batched eval_cost (src/LossFunctions.jl:193-209): (cost, loss, complexity).
 
     ``turbo`` routes through the fused Pallas eval+loss kernel (the hot
@@ -279,6 +290,20 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
     complexity = compute_complexity_batch(trees, tables)
     cost = loss_to_cost(loss, data.baseline_loss, data.use_baseline, complexity,
                         parsimony)
+    if data.x_dims is not None and dim_penalty is not None:
+        # Single-sample dimensional check on the full dataset's first row
+        # (src/DimensionalAnalysis.jl:223-257); violations add a flat cost
+        # penalty (src/LossFunctions.jl:236-245).
+        from ..ops.dims_eval import dimensional_violations_batch
+
+        viol = dimensional_violations_batch(
+            trees, data.Xt[:, 0], data.x_dims,
+            (jnp.zeros((7,), jnp.float32) if data.y_dims is None
+             else data.y_dims),
+            jnp.bool_(data.y_dims is not None),
+            operators, wildcard_constants=wildcard_constants,
+        )
+        cost = cost + jnp.asarray(dim_penalty, cost.dtype) * viol
     return cost, loss, complexity
 
 
@@ -389,6 +414,7 @@ def generation_step(
         both, data, elementwise_loss, tables, cfg.operators, cfg.parsimony,
         batch_idx=batch_idx, turbo=cfg.turbo, interpret=cfg.interpret,
         loss_function=options.resolved_loss_function,
+        dim_penalty=cfg.dim_penalty, wildcard_constants=cfg.wildcard_constants,
     )
     needs_eval = jnp.stack([needs_eval1, needs_eval2], axis=1)
     num_evals = jnp.sum(needs_eval.astype(jnp.float32))

@@ -154,19 +154,21 @@ def eval_grad_tree_array(
 
     if variable:
         def run(Xt_):
-            y, _ = eval_single_tree(a, o, f, c, ln, ch, Xt_, operators)
-            return y
+            y, valid = eval_single_tree(a, o, f, c, ln, ch, Xt_, operators)
+            # float-typed so it can ride through jvp as a primal output
+            return y, valid.astype(Xt_.dtype)
 
-        y, valid = eval_single_tree(a, o, f, c, ln, ch, Xt, operators)
         # One JVP per feature: dy_i/dX[f, i] (diagonal of the per-row
         # Jacobian — each output row only depends on its own input row).
+        # The primal (y, valid) comes along with the first JVP for free,
+        # so the tree is evaluated exactly F times, not F+1.
         def per_feature(fidx):
             seed = jnp.zeros_like(Xt).at[fidx].set(1.0)
-            _, dy = jax.jvp(run, (Xt,), (seed,))
-            return dy
+            (y_, valid_), (dy, _) = jax.jvp(run, (Xt,), (seed,))
+            return y_, valid_, dy
 
-        grad = jax.vmap(per_feature)(jnp.arange(Xt.shape[0]))
-        return y, grad, valid
+        ys, valids, grad = jax.vmap(per_feature)(jnp.arange(Xt.shape[0]))
+        return ys[0], grad, valids[0] > 0
 
     # w.r.t. constants: differentiate the const slot vector, then gather
     # the rows belonging to actual constant leaves.
@@ -296,6 +298,11 @@ def D(tree: Node, feature: int) -> Node:
                 _sub(_mul(da, bc), _mul(ac, db)), _mul(b.copy(), b.copy())
             )
         if name == "^":
+            if b.degree == 0 and b.constant:
+                # Constant exponent: d(a^c) = c*a^(c-1)*da — valid at a=0
+                # and for negative bases with integer c, where the log(a)
+                # form below would be NaN.
+                return _mul(_mul(bc, _pow(a.copy(), _c(b.val - 1.0))), da)
             # d(a^b) = a^b * (db*log(a) + b*da/a)
             term1 = _mul(db, _un("log", ac))
             term2 = _div(_mul(bc, da), a.copy())

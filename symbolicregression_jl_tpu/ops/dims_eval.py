@@ -1,0 +1,315 @@
+"""Device-side dimensional-constraint check.
+
+TPU-native redesign of the reference's dimensional analysis
+(/root/reference/src/DimensionalAnalysis.jl:46-275): instead of abstract
+interpretation with `WildcardQuantity` objects on the host, we propagate a
+``(value, dims[7], wildcard)`` triple through the postfix slot buffer in a
+single `lax.scan` — the same structure as the eval kernel — over ONE data
+sample (the reference also uses a single-sample check,
+src/DimensionalAnalysis.jl:223-257). One launch checks a whole population.
+
+Lattice semantics (mirroring src/DimensionalAnalysis.jl:64-195):
+- constants (and parameters) are *wildcards* — their dimensions are free,
+  so any op can absorb them (disabled by ``dimensionless_constants_only``);
+- `+`/`-`/`min`/`max`/`mod` require matching dims (a wildcard side adopts
+  the other's dims);
+- `*`/`/` add/subtract exponents; a wildcard side keeps the result wildcard;
+- `^` requires a dimensionless exponent and scales the base dims by the
+  exponent's *numeric value* at the sample (this is why values are carried);
+- comparisons require matching dims and return dimensionless;
+- `sqrt`/`cbrt`/`square`/`cube`/`inv` scale exponents; `neg`/`abs`/… are
+  dimension-preserving; all other scalar functions (sin, exp, log, custom
+  ops, …) require dimensionless (or wildcard) input and return
+  dimensionless.
+
+A violation anywhere, or a root whose dims cannot match ``y``'s, flags the
+tree; the search adds ``dimensional_constraint_penalty`` (default 1000,
+src/LossFunctions.jl:236-245) to that member's cost.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encoding import LEAF_CONST, LEAF_PARAM, MAX_ARITY, TreeBatch, tree_structure_arrays
+from .operators import OperatorSet
+
+__all__ = [
+    "dimensional_violations_batch",
+    "classify_operators",
+    "violates_dimensional_constraints",
+]
+
+N_DIMS = 7
+_TOL = 1e-4
+
+# Unary classes
+U_GENERIC = 0   # dimensionless in, dimensionless out
+U_IDENT = 1     # dims-preserving
+U_SQRT = 2
+U_CBRT = 3
+U_SQUARE = 4
+U_CUBE = 5
+U_INV = 6
+U_SIGN = 7      # any dims in, dimensionless out
+
+_UNARY_CLASS = {
+    "neg": U_IDENT, "abs": U_IDENT, "relu": U_IDENT, "round": U_IDENT,
+    "floor": U_IDENT, "ceil": U_IDENT,
+    "sqrt": U_SQRT, "cbrt": U_CBRT, "square": U_SQUARE, "cube": U_CUBE,
+    "inv": U_INV, "sign": U_SIGN,
+}
+
+# Binary classes
+B_GENERIC = 0   # both dimensionless in, dimensionless out
+B_ADD = 1       # matching dims, same dims out
+B_MUL = 2
+B_DIV = 3
+B_POW = 4
+B_CMP = 5       # matching dims in, dimensionless out
+B_COND = 6      # (x > 0) * y : any x, y dims out
+
+_BINARY_CLASS = {
+    "+": B_ADD, "-": B_ADD, "max": B_ADD, "min": B_ADD, "mod": B_ADD,
+    "*": B_MUL, "/": B_DIV, "^": B_POW,
+    "greater": B_CMP, "less": B_CMP, "greater_equal": B_CMP,
+    "less_equal": B_CMP, "logical_or": B_CMP, "logical_and": B_CMP,
+    "atan2": B_CMP,
+    "cond": B_COND,
+}
+
+
+def classify_operators(operators: OperatorSet) -> Tuple[np.ndarray, np.ndarray]:
+    """Static per-op dimension-semantics class tables (unary, binary)."""
+    ucls = np.asarray(
+        [_UNARY_CLASS.get(op.name, U_GENERIC) for op in operators.unary]
+        or [U_GENERIC],
+        np.int32,
+    )
+    bcls = np.asarray(
+        [_BINARY_CLASS.get(op.name, B_GENERIC) for op in operators.binary]
+        or [B_GENERIC],
+        np.int32,
+    )
+    return ucls, bcls
+
+
+def _dims_match(d1, d2):
+    return jnp.all(jnp.abs(d1 - d2) <= _TOL)
+
+
+def _dimless(d):
+    return jnp.all(jnp.abs(d) <= _TOL)
+
+
+def _single_tree_violation(
+    arity, op, feat, const, length, child,
+    x_sample,      # [F] one row of X
+    x_dims,        # [F, 7]
+    y_dims,        # [7]
+    check_y,       # bool scalar
+    operators: OperatorSet,
+    wildcard_constants: bool,
+):
+    L = arity.shape[0]
+    ucls_np, bcls_np = classify_operators(operators)
+    ucls = jnp.asarray(ucls_np)
+    bcls = jnp.asarray(bcls_np)
+
+    def step(carry, k):
+        val_buf, dim_buf, wild_buf, viol = carry
+        a = arity[k]
+        o = op[k]
+        cvals = [
+            jax.lax.dynamic_index_in_dim(val_buf, child[k, j], 0, keepdims=False)
+            for j in range(MAX_ARITY)
+        ]
+        cdims = [
+            jax.lax.dynamic_index_in_dim(dim_buf, child[k, j], 0, keepdims=False)
+            for j in range(MAX_ARITY)
+        ]
+        cwild = [
+            jax.lax.dynamic_index_in_dim(wild_buf, child[k, j], 0, keepdims=False)
+            for j in range(MAX_ARITY)
+        ]
+
+        # ---- leaf ----
+        is_const_leaf = (o == LEAF_CONST) | (o == LEAF_PARAM)
+        x_val = jax.lax.dynamic_index_in_dim(x_sample, feat[k], 0, keepdims=False)
+        xd = jax.lax.dynamic_index_in_dim(x_dims, feat[k], 0, keepdims=False)
+        leaf_val = jnp.where(is_const_leaf, const[k].astype(jnp.float32), x_val)
+        leaf_dims = jnp.where(is_const_leaf, jnp.zeros((N_DIMS,), jnp.float32), xd)
+        leaf_wild = is_const_leaf & jnp.bool_(wildcard_constants)
+
+        # ---- unary ----
+        c0v, c0d, c0w = cvals[0], cdims[0], cwild[0]
+        uc = ucls[jnp.clip(o, 0, ucls.shape[0] - 1)]
+        u_exp_scale = jnp.select(
+            [uc == U_SQRT, uc == U_CBRT, uc == U_SQUARE, uc == U_CUBE,
+             uc == U_INV, uc == U_IDENT],
+            [0.5, 1.0 / 3.0, 2.0, 3.0, -1.0, 1.0],
+            0.0,  # generic / sign: dimensionless out
+        )
+        u_dims = c0d * u_exp_scale
+        u_preserves = (uc == U_IDENT) | (uc == U_SQRT) | (uc == U_CBRT) | \
+            (uc == U_SQUARE) | (uc == U_CUBE) | (uc == U_INV)
+        u_wild = c0w & u_preserves
+        u_viol = (uc == U_GENERIC) & ~c0w & ~_dimless(c0d)
+
+        # ---- binary ----
+        c1v, c1d, c1w = cvals[1], cdims[1], cwild[1]
+        bc = bcls[jnp.clip(o, 0, bcls.shape[0] - 1)]
+        both_wild = c0w & c1w
+        either_wild = c0w | c1w
+        add_dims = jnp.where(c0w, c1d, c0d)
+        add_viol = ~c0w & ~c1w & ~_dims_match(c0d, c1d)
+        mul_dims = c0d + c1d
+        div_dims = c0d - c1d
+        pow_dims = c0d * c1v
+        pow_viol = ~c1w & ~_dimless(c1d)
+        gen_viol = (~c0w & ~_dimless(c0d)) | (~c1w & ~_dimless(c1d))
+
+        b_dims = jnp.select(
+            [bc == B_ADD, bc == B_MUL, bc == B_DIV, bc == B_POW,
+             bc == B_COND],
+            [add_dims, mul_dims, div_dims, pow_dims, c1d],
+            jnp.zeros((N_DIMS,), jnp.float32),  # generic / cmp
+        )
+        b_wild = jnp.select(
+            [bc == B_ADD, bc == B_MUL, bc == B_DIV, bc == B_POW,
+             bc == B_COND],
+            [both_wild, either_wild, either_wild, c0w, c1w],
+            jnp.bool_(False),
+        )
+        b_viol = jnp.select(
+            [bc == B_ADD, bc == B_CMP, bc == B_POW, bc == B_GENERIC],
+            [add_viol, add_viol, pow_viol, gen_viol],
+            jnp.bool_(False),
+        )
+
+        # wildcard output dims are canonically zero (free to rescale)
+        out_dims = jnp.where(
+            a == 0, leaf_dims, jnp.where(a == 1, u_dims, b_dims)
+        )
+        out_wild = jnp.where(a == 0, leaf_wild, jnp.where(a == 1, u_wild, b_wild))
+        out_dims = jnp.where(out_wild, jnp.zeros((N_DIMS,), jnp.float32), out_dims)
+        node_viol = jnp.where(
+            a == 0, jnp.bool_(False), jnp.where(a == 1, u_viol, b_viol)
+        )
+
+        # value propagation (single sample) for pow exponents
+        cval = _node_value(operators, a, o, leaf_val, cvals)
+
+        in_tree = k < length
+        viol = viol | (node_viol & in_tree)
+        val_buf = val_buf.at[k].set(cval)
+        dim_buf = dim_buf.at[k].set(out_dims)
+        wild_buf = wild_buf.at[k].set(out_wild)
+        return (val_buf, dim_buf, wild_buf, viol), None
+
+    carry0 = (
+        jnp.zeros((L,), jnp.float32),
+        jnp.zeros((L, N_DIMS), jnp.float32),
+        jnp.zeros((L,), jnp.bool_),
+        jnp.bool_(False),
+    )
+    (val_buf, dim_buf, wild_buf, viol), _ = jax.lax.scan(
+        step, carry0, jnp.arange(L, dtype=jnp.int32)
+    )
+    root = length - 1
+    root_dims = jax.lax.dynamic_index_in_dim(dim_buf, root, 0, keepdims=False)
+    root_wild = jax.lax.dynamic_index_in_dim(wild_buf, root, 0, keepdims=False)
+    y_viol = check_y & ~root_wild & ~_dims_match(root_dims, y_dims)
+    return viol | y_viol
+
+
+def _node_value(operators: OperatorSet, a, o, leaf, cvals):
+    """Single-sample value of one node (f32), for `^` exponent lookup."""
+    val = leaf
+    if operators.unary:
+        un = jnp.stack(
+            [op.fn(cvals[0]).astype(jnp.float32) for op in operators.unary]
+        )
+        val = jnp.where(
+            a == 1,
+            jax.lax.dynamic_index_in_dim(
+                un, jnp.clip(o, 0, len(operators.unary) - 1), 0, keepdims=False
+            ),
+            val,
+        )
+    if operators.binary:
+        bi = jnp.stack(
+            [
+                op.fn(cvals[0], cvals[1]).astype(jnp.float32)
+                for op in operators.binary
+            ]
+        )
+        val = jnp.where(
+            a == 2,
+            jax.lax.dynamic_index_in_dim(
+                bi, jnp.clip(o, 0, len(operators.binary) - 1), 0, keepdims=False
+            ),
+            val,
+        )
+    return val
+
+
+@partial(jax.jit, static_argnames=("operators", "wildcard_constants"))
+def dimensional_violations_batch(
+    batch: TreeBatch,
+    x_sample: jax.Array,   # [F]
+    x_dims: jax.Array,     # [F, 7]
+    y_dims: jax.Array,     # [7]
+    check_y,               # bool scalar
+    operators: OperatorSet,
+    wildcard_constants: bool = True,
+) -> jax.Array:
+    """``violates[...batch]`` — True where a tree breaks unit constraints."""
+    batch_shape = batch.batch_shape
+    flat = batch.reshape(-1)
+    child, _, _ = tree_structure_arrays(flat)
+    f = jax.vmap(
+        lambda a, o, ft, c, ln, ch: _single_tree_violation(
+            a, o, ft, c, ln, ch,
+            x_sample.astype(jnp.float32), x_dims, y_dims, check_y,
+            operators, wildcard_constants,
+        )
+    )
+    viol = f(flat.arity, flat.op, flat.feat, flat.const, flat.length, child)
+    return viol.reshape(batch_shape)
+
+
+def violates_dimensional_constraints(tree, dataset, options=None) -> bool:
+    """Host API: does this expression break the dataset's unit constraints?
+
+    (`violates_dimensional_constraints`,
+    /root/reference/src/DimensionalAnalysis.jl:223-275.) ``tree`` is a host
+    :class:`..ops.tree.Node`; ``dataset`` a :class:`..core.dataset.Dataset`
+    with units. Returns False when the dataset has no units.
+    """
+    from ..core.options import Options
+    from .encoding import encode_population
+
+    data = dataset.data
+    if data.x_dims is None:
+        return False
+    options = options or Options()
+    operators = options.operators
+    max_nodes = max(tree.count_nodes(), 1)
+    batch = encode_population(
+        [tree], max_nodes, operators, np.dtype(np.float32)
+    )
+    viol = dimensional_violations_batch(
+        batch, data.Xt[:, 0], data.x_dims,
+        (jnp.zeros((N_DIMS,), jnp.float32) if data.y_dims is None
+         else data.y_dims),
+        jnp.bool_(data.y_dims is not None),
+        operators,
+        wildcard_constants=not options.dimensionless_constants_only,
+    )
+    return bool(viol[0])

@@ -116,4 +116,12 @@ def shard_device_data(data, mesh: Mesh):
         class_idx=place(data.class_idx, 0),
         baseline_loss=jax.device_put(data.baseline_loss, replicated(mesh)),
         use_baseline=jax.device_put(data.use_baseline, replicated(mesh)),
+        x_dims=(
+            None if data.x_dims is None
+            else jax.device_put(data.x_dims, replicated(mesh))
+        ),
+        y_dims=(
+            None if data.y_dims is None
+            else jax.device_put(data.y_dims, replicated(mesh))
+        ),
     )

@@ -1,0 +1,223 @@
+"""Dimensional analysis tests.
+
+Mirrors the reference's unit-handling test coverage
+(test/integration/ext/dynamicquantities_units — 484 LoC of cases):
+unit parsing, wildcard-constant semantics, per-operator propagation,
+and the cost penalty inside the search.
+"""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, make_dataset, parse_expression
+from symbolicregression_jl_tpu.core.units import (
+    DIMENSIONLESS,
+    Dimensions,
+    dims_to_array,
+    parse_unit,
+    pretty_dims,
+)
+from symbolicregression_jl_tpu.ops.dims_eval import (
+    violates_dimensional_constraints,
+)
+
+
+def dims(**kw):
+    idx = {"m": 0, "kg": 1, "s": 2, "A": 3, "K": 4, "cd": 5, "mol": 6}
+    e = [0.0] * 7
+    for k, v in kw.items():
+        e[idx[k]] = v
+    return np.asarray(e, np.float32)
+
+
+class TestUnitParsing:
+    def test_base_units(self):
+        assert np.allclose(dims_to_array(parse_unit("m").dims), dims(m=1))
+        assert np.allclose(dims_to_array(parse_unit("s").dims), dims(s=1))
+        assert np.allclose(dims_to_array(parse_unit("kg").dims), dims(kg=1))
+
+    def test_compound(self):
+        q = parse_unit("m/s^2")
+        assert np.allclose(dims_to_array(q.dims), dims(m=1, s=-2))
+        q = parse_unit("kg*m^2/s^2")  # joule
+        assert np.allclose(dims_to_array(q.dims), dims(kg=1, m=2, s=-2))
+
+    def test_space_multiplication(self):
+        q = parse_unit("kg m s^-2")  # newton
+        assert np.allclose(dims_to_array(q.dims), dims(kg=1, m=1, s=-2))
+
+    def test_derived_units(self):
+        assert np.allclose(
+            dims_to_array(parse_unit("N").dims), dims(kg=1, m=1, s=-2)
+        )
+        assert np.allclose(
+            dims_to_array(parse_unit("J").dims), dims(kg=1, m=2, s=-2)
+        )
+        assert np.allclose(dims_to_array(parse_unit("Hz").dims), dims(s=-1))
+
+    def test_prefixes(self):
+        km = parse_unit("km")
+        assert km.scale == pytest.approx(1000.0)
+        assert np.allclose(dims_to_array(km.dims), dims(m=1))
+        mg = parse_unit("mg")
+        assert mg.scale == pytest.approx(1e-6)
+        assert np.allclose(dims_to_array(mg.dims), dims(kg=1))
+
+    def test_dimensionless(self):
+        for spec in (None, "", "1"):
+            assert parse_unit(spec).dims.is_dimensionless
+
+    def test_fractional_exponent(self):
+        q = parse_unit("m^0.5")
+        assert np.allclose(dims_to_array(q.dims), dims(m=0.5))
+
+    def test_unknown_unit_raises(self):
+        with pytest.raises(ValueError):
+            parse_unit("furlong")
+
+    def test_pretty(self):
+        assert pretty_dims(parse_unit("m/s^2").dims) == "m s⁻²"
+        assert pretty_dims(DIMENSIONLESS) == ""
+
+    def test_dimensions_algebra(self):
+        a = Dimensions.base(0)  # m
+        b = Dimensions.base(2)  # s
+        assert (a / b).exps[0] == 1 and (a / b).exps[2] == -1
+        assert (a ** 2).exps[0] == 2
+
+
+def _ds(X_units, y_units, nfeat=2):
+    rng = np.random.default_rng(0)
+    X = rng.uniform(1.0, 2.0, (16, nfeat))
+    y = rng.uniform(1.0, 2.0, 16)
+    return make_dataset(X, y, X_units=X_units, y_units=y_units)
+
+
+@pytest.fixture(scope="module")
+def opts():
+    return Options(
+        binary_operators=["+", "-", "*", "/", "^"],
+        unary_operators=["sin", "sqrt", "square", "neg", "abs"],
+    )
+
+
+def _viol(expr, ds, options):
+    tree = parse_expression(expr, options.operators,
+                            variable_names=ds.variable_names)
+    return violates_dimensional_constraints(tree, ds, options)
+
+
+class TestDimensionalConstraints:
+    def test_no_units_never_violates(self, opts):
+        ds = make_dataset(np.ones((4, 2)), np.ones(4))
+        assert not _viol("x1 + x2", ds, opts)
+
+    def test_matching_division(self, opts):
+        ds = _ds(["m", "s"], "m/s")
+        assert not _viol("x1 / x2", ds, opts)
+
+    def test_mismatched_addition(self, opts):
+        ds = _ds(["m", "s"], "m")
+        assert _viol("x1 + x2", ds, opts)
+
+    def test_addition_same_units(self, opts):
+        ds = _ds(["m", "m"], "m")
+        assert not _viol("x1 + x2", ds, opts)
+
+    def test_y_mismatch(self, opts):
+        ds = _ds(["m", "s"], "kg")
+        assert _viol("x1 / x2", ds, opts)
+
+    def test_wildcard_constant_absorbs_units(self, opts):
+        # c * x1 can match any output unit: c's dims are free
+        ds = _ds(["m", "s"], "kg")
+        assert not _viol("3.2 * x1", ds, opts)
+
+    def test_wildcard_inside_transcendental(self, opts):
+        # sin(c * x1) is fine: c absorbs x1's dims
+        ds = _ds(["m", "s"], "1")
+        assert not _viol("sin(1.5 * x1)", ds, opts)
+
+    def test_transcendental_of_dimensional_violates(self, opts):
+        ds = _ds(["m", "s"], "1")
+        assert _viol("sin(x1)", ds, opts)
+        # x1/x2 still carries m/s here, so sin of it also violates
+        assert _viol("sin(x1 / x2)", ds, opts)
+
+    def test_transcendental_of_ratio(self, opts):
+        ds = _ds(["m", "m"], "1")
+        assert not _viol("sin(x1 / x2)", ds, opts)
+
+    def test_sqrt_and_square(self, opts):
+        ds = _ds(["m^2", "s"], "m")
+        assert not _viol("sqrt(x1)", ds, opts)
+        ds2 = _ds(["m", "s"], "m^2")
+        assert not _viol("square(x1)", ds2, opts)
+        assert _viol("sqrt(x1)", ds2, opts)
+
+    def test_pow_integer_constant(self, opts):
+        ds = _ds(["m", "s"], "m^2")
+        assert not _viol("x1 ^ 2.0", ds, opts)
+        assert _viol("x1 ^ 3.0", ds, opts)
+
+    def test_pow_dimensional_exponent_violates(self, opts):
+        ds = _ds(["m", "s"], "1")
+        # exponent carrying units is illegal even though base is wildcard
+        assert _viol("2.0 ^ x2", ds, opts)
+
+    def test_neg_abs_preserve(self, opts):
+        ds = _ds(["m", "s"], "m")
+        assert not _viol("neg(x1)", ds, opts)
+        assert not _viol("abs(x1)", ds, opts)
+
+    def test_missing_y_units_accepts_any_output_dims(self, opts):
+        # X units given, y units absent: output dims unconstrained
+        # (src/DimensionalAnalysis.jl:250-255)
+        ds = _ds(["m", "s"], None)
+        assert not _viol("x1 / x2", ds, opts)
+        assert not _viol("x1", ds, opts)
+        # internal violations still count
+        assert _viol("x1 + x2", ds, opts)
+
+    def test_dimensionless_constants_only(self):
+        options = Options(
+            binary_operators=["+", "*"],
+            unary_operators=["sin"],
+            dimensionless_constants_only=True,
+        )
+        ds = _ds(["m", "s"], "1")
+        # with rigid constants, c * x1 cannot match dimensionless y
+        assert _viol("3.2 * x1", ds, options)
+        ds2 = _ds(["1", "1"], "1")
+        assert not _viol("3.2 * x1", ds2, options)
+
+
+class TestSearchWithUnits:
+    def test_search_respects_units(self):
+        # y = x1/x2 with units m, s -> m/s; the penalty should steer the
+        # search to unit-consistent expressions.
+        rng = np.random.default_rng(42)
+        X = rng.uniform(0.5, 2.0, (128, 2))
+        y = X[:, 0] / X[:, 1]
+        from symbolicregression_jl_tpu import equation_search
+
+        options = Options(
+            binary_operators=["+", "-", "*", "/"],
+            populations=2,
+            population_size=20,
+            ncycles_per_iteration=20,
+            maxsize=12,
+            save_to_file=False,
+        )
+        hof = equation_search(
+            X, y, options=options, niterations=4,
+            X_units=["m", "s"], y_units="m/s",
+            verbosity=0, seed=0,
+        )
+        best = min(hof.entries, key=lambda e: e.loss)
+        assert best.loss < 1e-2
+
+    def test_unit_annotated_display_names(self):
+        ds = _ds(["m", "s"], "m/s")
+        assert ds.display_variable_names[0].endswith("[m]")
+        assert ds.display_variable_names[1].endswith("[s]")
