@@ -51,4 +51,8 @@ def __getattr__(name):
         from .api import regressor
 
         return getattr(regressor, name)
+    if name in ("ExpressionSpec", "ParametricExpressionSpec"):
+        from . import models
+
+        return getattr(models, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

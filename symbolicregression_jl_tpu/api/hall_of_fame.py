@@ -39,6 +39,9 @@ class HallOfFameEntry:
     cost: float
     complexity: int
     score: float = 0.0
+    # (n_params, n_classes) parameter matrix for parametric expressions
+    # (/root/reference/src/ParametricExpression.jl:35-51), else None.
+    params: Optional[np.ndarray] = None
 
     def __repr__(self) -> str:  # pragma: no cover
         return (
@@ -65,6 +68,8 @@ class HallOfFame:
         feat = np.asarray(hof_state.trees.feat)
         const = np.asarray(hof_state.trees.const)
         length = np.asarray(hof_state.trees.length)
+        params = np.asarray(hof_state.params)
+        parametric = params.shape[-2] > 0
         entries = []
         for i in range(exists.shape[0]):
             if not exists[i]:
@@ -78,6 +83,7 @@ class HallOfFame:
                     loss=float(loss[i]),
                     cost=float(cost[i]),
                     complexity=int(complexity[i]),
+                    params=params[i] if parametric else None,
                 )
             )
         entries.sort(key=lambda e: e.complexity)

@@ -50,6 +50,8 @@ class EquationRecord:
     score: float
     equation: str
     tree: Node
+    # (n_params, n_classes) for parametric expressions, else None.
+    params: Optional[np.ndarray] = None
 
 
 class SRRegressor:
@@ -99,6 +101,7 @@ class SRRegressor:
         self.nfeatures_: Optional[int] = None
         self.variable_names_: Optional[Sequence[str]] = None
         self.fitted_iterations_: int = 0
+        self.classes_: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------
     def _make_options(self) -> Options:
@@ -148,8 +151,13 @@ class SRRegressor:
         )
 
         extra = None
+        self.classes_ = None
         if category is not None:
-            extra = {"class": np.asarray(category)}
+            cat = np.asarray(category)
+            extra = {"class": cat}
+            # Training class -> parameter-column mapping (mirrors
+            # make_dataset's searchsorted encoding) for predict-time reuse.
+            self.classes_ = np.unique(cat)
 
         # Warm-start refits run only the *delta* iterations
         # (src/MLJInterface.jl:292-294): fitting twice with the same
@@ -211,6 +219,7 @@ class SRRegressor:
                         e.tree, variable_names=self.variable_names_
                     ),
                     tree=e.tree,
+                    params=e.params,
                 )
                 for e in frontier
             ]
@@ -239,15 +248,34 @@ class SRRegressor:
             raise RuntimeError("This SRRegressor instance is not fitted yet.")
 
     # ------------------------------------------------------------------
-    def _predict_one(self, recs, idx, X) -> np.ndarray:
+    def _predict_one(self, recs, idx, X, category=None) -> np.ndarray:
         import jax.numpy as jnp
 
-        tree = recs[idx].tree
+        rec = recs[idx]
+        tree = rec.tree
         enc = encode_population(
             [tree], max(tree.count_nodes(), 1), self.options_.operators
         )
+        params = None
+        if rec.params is not None and rec.params.shape[0] > 0:
+            if category is None:
+                raise ValueError(
+                    "This model was fit with a parametric expression spec; "
+                    "predict requires `category=`"
+                )
+            cat = np.asarray(category)
+            cls = np.searchsorted(self.classes_, cat)
+            cls = np.clip(cls, 0, rec.params.shape[1] - 1)
+            unseen = self.classes_[cls] != cat
+            if np.any(unseen):
+                raise ValueError(
+                    "predict got categories not seen during fit: "
+                    f"{np.unique(cat[unseen])!r} (known: {self.classes_!r})"
+                )
+            # Per-row parameter values p[k, row] = params[k, class[row]].
+            params = jnp.asarray(rec.params[:, cls])[None]
         y, valid = eval_tree_batch(
-            enc, jnp.asarray(X.T), self.options_.operators
+            enc, jnp.asarray(X.T), self.options_.operators, params=params
         )
         out = np.asarray(y[0])
         if not bool(valid[0]):
@@ -256,7 +284,8 @@ class SRRegressor:
             out = np.zeros(X.shape[0], out.dtype)
         return out
 
-    def predict(self, X, idx: Optional[Union[int, Sequence[int]]] = None):
+    def predict(self, X, idx: Optional[Union[int, Sequence[int]]] = None,
+                *, category=None):
         """Predict with the selected (or ``idx``-chosen) equation."""
         self._check_fitted()
         X = np.asarray(X)
@@ -268,18 +297,18 @@ class SRRegressor:
             else:
                 idxs = list(idx)
             outs = [
-                self._predict_one(recs, i, X)
+                self._predict_one(recs, i, X, category)
                 for recs, i in zip(self.equations_, idxs)
             ]
             return np.stack(outs, axis=1)
         i = int(idx) if idx is not None else int(self.best_idx_)
-        return self._predict_one(self.equations_, i, X)
+        return self._predict_one(self.equations_, i, X, category)
 
-    def score(self, X, y, *, sample_weight=None) -> float:
+    def score(self, X, y, *, sample_weight=None, category=None) -> float:
         """Coefficient of determination R^2 (sklearn convention)."""
         self._check_fitted()
         y = np.asarray(y)
-        pred = self.predict(X)
+        pred = self.predict(X, category=category)
         if self._MULTITARGET:
             pred = pred.reshape(y.shape)
         w = (

@@ -178,7 +178,16 @@ def _seed_population(
         list(trees)[: I * P], cfg.max_nodes, cfg.operators, np.dtype(engine.dtype)
     )
     n_seed = enc.length.shape[0]
-    cost, loss, cx = engine._eval_cost(enc, data)
+    # Parametric: seeds get fresh randn parameter banks (extra_init_params
+    # with prototype=None, /root/reference/src/ParametricExpression.jl:35-51).
+    from ..evolve.population import init_params
+
+    k_seed, k_next = jax.random.split(state.key)
+    state = dataclasses.replace(state, key=k_next)
+    seed_params = init_params(
+        k_seed, (n_seed,), engine.n_params, engine.n_classes, engine.dtype
+    )
+    cost, loss, cx = engine._eval_cost(enc, data, seed_params)
 
     pops = state.pops
     if mode == "tile":
@@ -202,6 +211,7 @@ def _seed_population(
             cost=jnp.take(cost, idx).reshape(I, P),
             loss=jnp.take(loss, idx).reshape(I, P),
             complexity=jnp.take(cx, idx).reshape(I, P),
+            params=tile(seed_params),
         )
     else:  # replace_worst on island 0
         k = min(n_seed, P)
@@ -223,6 +233,7 @@ def _seed_population(
             cost=put(pops.cost, cost),
             loss=put(pops.loss, loss),
             complexity=put(pops.complexity, cx),
+            params=put(pops.params, seed_params),
         )
     return dataclasses.replace(state, pops=pops)
 
@@ -322,8 +333,23 @@ def equation_search(
     engines: List[Engine] = []
     states: List[SearchDeviceState] = []
     datas = []
+    from ..models.spec import ParametricExpressionSpec
+
     for j, ds in enumerate(datasets):
-        engine = Engine(options, ds.nfeatures, dtype=_np_dtype(options.eval_dtype))
+        n_params = 0
+        n_classes = 0
+        if isinstance(options.expression_spec, ParametricExpressionSpec):
+            if ds.data.class_idx is None:
+                raise ValueError(
+                    "ParametricExpressionSpec requires a `class` column: "
+                    "pass extra={'class': ...} (the reference routes "
+                    "dataset.extra.class to the parameter gather, "
+                    "src/ParametricExpression.jl:88-100)"
+                )
+            n_params = options.expression_spec.max_parameters
+            n_classes = ds.n_classes
+        engine = Engine(options, ds.nfeatures, dtype=_np_dtype(options.eval_dtype),
+                        n_params=n_params, n_classes=n_classes)
         data = shard_device_data(ds.data, mesh)
         key, k_init = jax.random.split(key)
         if saved_state is not None and j < len(saved_state.device_states):

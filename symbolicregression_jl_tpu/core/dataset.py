@@ -173,8 +173,10 @@ def make_dataset(
         # Unit-annotated printing (the reference annotates variables with
         # their units when printing trees,
         # /root/reference/src/InterfaceDynamicExpressions.jl:199-317).
+        # Only plain string specs annotate; exponent-vector/Quantity forms
+        # have no compact display syntax.
         display_variable_names = [
-            f"{name}[{u}]" if u not in (None, "", "1") else name
+            f"{name}[{u}]" if isinstance(u, str) and u not in ("", "1") else name
             for name, u in zip(display_variable_names, X_units)
         ]
     if y_variable_name is None:

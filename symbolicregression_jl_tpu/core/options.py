@@ -514,7 +514,7 @@ class Options:
     _WARM_START_FIELDS = (
         "maxsize", "maxdepth", "loss_scale", "parsimony",
         "dimensional_constraint_penalty", "batching", "batch_size",
-        "population_size", "populations",
+        "population_size", "populations", "expression_spec",
     )
 
     def check_warm_start_compatibility(self, other: "Options") -> List[str]:

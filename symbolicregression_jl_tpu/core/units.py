@@ -199,14 +199,20 @@ def parse_unit(spec) -> Quantity:
     s = str(spec).strip()
     if s in ("", "1"):
         return Quantity()
-    # Tokenize on '*', '/', and whitespace, keeping the dividers.
-    parts = re.split(r"(\s*[*/]\s*|\s+)", s)
+    # Tokenize factors and '*'/'/' dividers. The factor pattern consumes a
+    # whole `unit^exp` including rational `//` exponents (`m^1//2`), so the
+    # exponent's slashes are never mistaken for division.
+    token_re = re.compile(
+        r"[^\s*/^]+(?:\^-?[0-9]+(?://[0-9]+|\.[0-9]+)?)?|[*/]"
+    )
     q = Quantity()
     divide = False
-    for part in parts:
-        part = part.strip()
-        if not part:
-            continue
+    pos = 0
+    for m in token_re.finditer(s):
+        if s[pos:m.start()].strip():
+            raise ValueError(f"Cannot parse unit spec {spec!r}")
+        pos = m.end()
+        part = m.group(0)
         if part == "*":
             divide = False
             continue
@@ -218,6 +224,8 @@ def parse_unit(spec) -> Quantity:
         # After a '/', only the immediately following factor is divided
         # when separated by spaces; '/' binds to the next single factor.
         divide = False
+    if s[pos:].strip():
+        raise ValueError(f"Cannot parse unit spec {spec!r}")
     return q
 
 

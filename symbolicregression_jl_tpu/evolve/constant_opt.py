@@ -243,22 +243,44 @@ def optimize_constants_batch(
     operators,
     cfg: OptimizerConfig,
     batch_idx: Optional[jax.Array] = None,
+    params: Optional[jax.Array] = None,      # [P, K, C] parameter banks
 ):
     """Optimize constants of selected trees; returns (new_const [P, L],
-    improved [P] bool, new_loss [P], f_calls [P])."""
+    improved [P] bool, new_loss [P], f_calls [P]) — plus new_params
+    [P, K, C] as the last element when ``params`` is given.
+
+    With ``params``, the parameter banks are optimized *jointly* with the
+    tree constants as one flattened vector (the reference includes all
+    parameters in the optimization vector,
+    /root/reference/src/ParametricExpression.jl:169-171).
+    """
     P, L = trees.arity.shape
+    parametric = params is not None and params.shape[-2] > 0
     if batch_idx is None:
         X, y, w = data.Xt, data.y, data.weights
+        class_idx = data.class_idx
     else:
         X = jnp.take(data.Xt, batch_idx, axis=1)
         y = jnp.take(data.y, batch_idx)
         w = None if data.weights is None else jnp.take(data.weights, batch_idx)
+        class_idx = (
+            None if data.class_idx is None else jnp.take(data.class_idx, batch_idx)
+        )
+    if parametric:
+        K, C = params.shape[-2:]
+        KC = K * C
+    else:
+        KC = 0
 
     child, _, _ = tree_structure_arrays(trees)
     slot = jnp.arange(L)
 
-    def member_fn(k, arity, op, feat, const0, length, ch, active):
-        mask = (slot < length) & (arity == 0) & (op == LEAF_CONST)
+    def member_fn(k, arity, op, feat, const0, length, ch, active, p0):
+        cmask = (slot < length) & (arity == 0) & (op == LEAF_CONST)
+        x0 = jnp.concatenate([const0, p0.reshape(-1)])
+        mask = jnp.concatenate(
+            [cmask, jnp.ones((KC,), jnp.bool_)]
+        )
 
         # Remat: recompute the interpreter forward during the backward pass
         # instead of storing per-slot scan residuals — the population ×
@@ -266,32 +288,44 @@ def optimize_constants_batch(
         # buffers on large datasets.
         @jax.checkpoint
         def f(x):
-            c = jnp.where(mask, x, const0)
+            c = jnp.where(cmask, x[:L], const0)
+            if parametric:
+                p_rows = jnp.take(x[L:].reshape(K, C), class_idx, axis=-1)
+            else:
+                p_rows = None
             pred, valid = eval_single_tree(arity, op, feat, c, length, ch, X,
-                                           operators)
+                                           operators, params=p_rows)
             return aggregate_loss(elementwise_loss, pred, y, valid, w)
 
-        baseline = f(const0)
+        baseline = f(x0)
 
         def run_from(x_init):
             return _bfgs_minimize(f, x_init, mask, cfg)
 
         # main start + nrestarts perturbed starts (x0 * (1 + 0.5 eps))
-        eps = jax.random.normal(k, (cfg.nrestarts, L), const0.dtype)
+        eps = jax.random.normal(k, (cfg.nrestarts, L + KC), x0.dtype)
         starts = jnp.concatenate(
-            [const0[None], const0[None] * (1.0 + 0.5 * eps)], axis=0
+            [x0[None], x0[None] * (1.0 + 0.5 * eps)], axis=0
         )
         xs, fs, calls = jax.vmap(run_from)(starts)
         best = jnp.argmin(jnp.where(jnp.isnan(fs), jnp.inf, fs))
         x_best, f_best = xs[best], fs[best]
         improved = active & (f_best < baseline) & jnp.isfinite(f_best)
-        new_const = jnp.where(improved & mask, x_best, const0)
+        new_const = jnp.where(improved & cmask, x_best[:L], const0)
+        new_p = jnp.where(improved, x_best[L:], x0[L:]).reshape(p0.shape)
         return new_const, improved, jnp.where(improved, f_best, baseline), (
             jnp.sum(calls) * active
-        )
+        ), new_p
 
     keys = jax.random.split(key, P)
-    return jax.vmap(member_fn)(
-        keys, trees.arity, trees.op, trees.feat, trees.const, trees.length,
-        child, do_opt,
+    p_in = (
+        params if parametric
+        else jnp.zeros((P, 0), trees.const.dtype)
     )
+    new_const, improved, new_loss, f_calls, new_params = jax.vmap(member_fn)(
+        keys, trees.arity, trees.op, trees.feat, trees.const, trees.length,
+        child, do_opt, p_in,
+    )
+    if params is not None:
+        return new_const, improved, new_loss, f_calls, new_params.reshape(params.shape)
+    return new_const, improved, new_loss, f_calls

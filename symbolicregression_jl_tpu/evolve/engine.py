@@ -35,7 +35,7 @@ from .constant_opt import (
     optimize_constants_batch,
     optimize_constants_fused,
 )
-from .population import PopulationState, init_population
+from .population import PopulationState, init_params, init_population
 from .simplify import fold_constants_batch
 from .step import (
     EvolveConfig,
@@ -88,11 +88,14 @@ class Engine:
     """Holds jitted computation for a fixed (options, dataset-shape) pair."""
 
     def __init__(self, options: Options, nfeatures: int, dtype=jnp.float32,
-                 window_size: int = 100_000):
+                 window_size: int = 100_000, n_params: int = 0,
+                 n_classes: int = 0):
         self.options = options
         self.nfeatures = nfeatures
         self.dtype = dtype
-        self.cfg: EvolveConfig = evolve_config_from_options(options, nfeatures)
+        self.cfg: EvolveConfig = evolve_config_from_options(
+            options, nfeatures, n_params, n_classes
+        )
         self.tables: ComplexityTables = build_complexity_tables(options, nfeatures)
         self.opt_cfg = OptimizerConfig(
             iterations=options.optimizer_iterations,
@@ -104,9 +107,10 @@ class Engine:
         # (cost, loss, complexity) for a flat batch of host-encoded trees —
         # the guess-seeding / warm-start re-eval path.
         self._eval_cost = jax.jit(
-            lambda trees, data: eval_cost_batch(
+            lambda trees, data, member_params=None: eval_cost_batch(
                 trees, data, self.options.elementwise_loss, self.tables,
                 self.cfg.operators, self.cfg.parsimony,
+                member_params=member_params,
                 turbo=self.cfg.turbo, interpret=self.cfg.interpret,
                 loss_function=self.options.resolved_loss_function,
                 dim_penalty=self.cfg.dim_penalty,
@@ -114,16 +118,27 @@ class Engine:
             )
         )
 
+    @property
+    def n_params(self) -> int:
+        return self.cfg.n_params
+
+    @property
+    def n_classes(self) -> int:
+        return self.cfg.n_classes
+
     # ------------------------------------------------------------------
     def init_state(self, key, data: DeviceData, n_islands: int,
-                   initial_trees: Optional[TreeBatch] = None) -> SearchDeviceState:
-        return self._init_state(key, data, n_islands, initial_trees)
+                   initial_trees: Optional[TreeBatch] = None,
+                   initial_params: Optional[jax.Array] = None) -> SearchDeviceState:
+        return self._init_state(key, data, n_islands, initial_trees,
+                                initial_params)
 
     def _init_state_impl(self, key, data: DeviceData, n_islands: int,
-                         initial_trees: Optional[TreeBatch] = None):
+                         initial_trees: Optional[TreeBatch] = None,
+                         initial_params: Optional[jax.Array] = None):
         cfg = self.cfg
         P = cfg.population_size
-        k_init, k_state = jax.random.split(key)
+        k_init, k_params, k_state = jax.random.split(key, 3)
 
         if initial_trees is None:
             keys = jax.random.split(k_init, n_islands)
@@ -132,17 +147,23 @@ class Engine:
             )(keys)
         else:
             trees = initial_trees
+        if initial_params is None:
+            params = init_params(
+                k_params, (n_islands, P), cfg.n_params, cfg.n_classes, self.dtype
+            )
+        else:
+            params = initial_params
 
         cost, loss, cx = jax.vmap(
-            lambda t: eval_cost_batch(
+            lambda t, p: eval_cost_batch(
                 t, data, self.options.elementwise_loss, self.tables,
-                cfg.operators, cfg.parsimony,
+                cfg.operators, cfg.parsimony, member_params=p,
                 turbo=cfg.turbo, interpret=cfg.interpret,
                 loss_function=self.options.resolved_loss_function,
                 dim_penalty=cfg.dim_penalty,
                 wildcard_constants=cfg.wildcard_constants,
             )
-        )(trees)
+        )(trees, params)
 
         pops = PopulationState(
             trees=trees,
@@ -154,6 +175,7 @@ class Engine:
                 jnp.arange(P, dtype=jnp.int32), (n_islands, P)
             ) + jnp.arange(n_islands, dtype=jnp.int32)[:, None] * 1_000_000,
             parent=jnp.full((n_islands, P), -1, jnp.int32),
+            params=params,
         )
         freq = jnp.ones((cfg.maxsize,), jnp.float32)
         stats = RunningStats(
@@ -161,7 +183,8 @@ class Engine:
         )
         return SearchDeviceState(
             pops=pops,
-            hof=empty_hof(cfg.maxsize, cfg.max_nodes, self.dtype),
+            hof=empty_hof(cfg.maxsize, cfg.max_nodes, self.dtype,
+                          cfg.n_params, cfg.n_classes),
             stats=stats,
             birth=jnp.full((n_islands,), P, jnp.int32),
             ref=jnp.full((n_islands,), P, jnp.int32),
@@ -251,15 +274,22 @@ class Engine:
             else:
                 opt_keys = jax.random.split(ko2, I)
 
-                def island_opt(k, trees: TreeBatch, idx, g):
+                def island_opt(k, trees: TreeBatch, idx, g, p):
                     sub = jax.tree.map(lambda x: jnp.take(x, idx, axis=0), trees)
+                    sub_p = jnp.take(p, idx, axis=0)
                     return optimize_constants_batch(
                         k, sub, g, data, el_loss,
                         cfg.operators, self.opt_cfg, batch_idx=batch_idx,
+                        params=sub_p,
                     )
-                new_const_sub, improved, _, f_calls = jax.vmap(island_opt)(
-                    opt_keys, pops.trees, sel_idx, gate
+                (new_const_sub, improved, _, f_calls,
+                 new_params_sub) = jax.vmap(island_opt)(
+                    opt_keys, pops.trees, sel_idx, gate, pops.params
                 )
+                new_params = jax.vmap(lambda p, i, np_: p.at[i].set(np_))(
+                    pops.params, sel_idx, new_params_sub
+                )
+                pops = dataclasses.replace(pops, params=new_params)
             new_const = jax.vmap(lambda c, i, nc: c.at[i].set(nc))(
                 pops.trees.const, sel_idx, new_const_sub
             )
@@ -271,14 +301,15 @@ class Engine:
         # ---- finalize costs on the full dataset (finalize_costs,
         # src/Population.jl:182-196; always re-eval after simplify/opt) ----
         cost, loss, cx = jax.vmap(
-            lambda t: eval_cost_batch(
+            lambda t, p: eval_cost_batch(
                 t, data, el_loss, tables, cfg.operators, cfg.parsimony,
+                member_params=p,
                 turbo=cfg.turbo, interpret=cfg.interpret,
                 loss_function=options.resolved_loss_function,
                 dim_penalty=cfg.dim_penalty,
                 wildcard_constants=cfg.wildcard_constants,
             )
-        )(pops.trees)
+        )(pops.trees, pops.params)
         pops = dataclasses.replace(pops, cost=cost, loss=loss, complexity=cx)
         num_evals = num_evals + I * P
 
@@ -302,6 +333,7 @@ class Engine:
                 birth=jnp.zeros((I * cfg.maxsize,), jnp.int32),
                 ref=jnp.zeros((I * cfg.maxsize,), jnp.int32),
                 parent=jnp.zeros((I * cfg.maxsize,), jnp.int32),
+                params=flat_best.params,
             ),
             cfg.maxsize,
         )
@@ -334,6 +366,7 @@ class Engine:
                     birth=jnp.zeros((cfg.maxsize,), jnp.int32),
                     ref=jnp.zeros((cfg.maxsize,), jnp.int32),
                     parent=jnp.zeros((cfg.maxsize,), jnp.int32),
+                    params=hof.params,
                 )
                 pops, birth = _migrate(
                     km2, pops, hof_pool, options.fraction_replaced_hof,
@@ -402,5 +435,6 @@ def _migrate(key, pops: PopulationState, pool: PopulationState, frac: float,
         birth=jnp.where(replace, new_birth_ticks, pops.birth),
         ref=sel(picked.ref, pops.ref),
         parent=sel(picked.parent, pops.parent),
+        params=sel(picked.params, pops.params),
     )
     return out, birth + P

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..ops.encoding import (
     LEAF_CONST,
+    LEAF_PARAM,
     LEAF_VAR,
     MAX_ARITY,
     TreeBatch,
@@ -52,6 +53,7 @@ class MutationContext(NamedTuple):
     max_nodes: int         # static (L)
     perturbation_factor: float
     probability_negate_constant: float
+    n_params: int = 0      # static; >0 => parametric leaf sampling
 
 
 def _slot_mask(tree: TreeBatch):
@@ -102,6 +104,21 @@ def mutate_constant(key, tree: TreeBatch, temperature, ctx: MutationContext):
     new_const = tree.const.at[idx].multiply(factor)
     const = jnp.where(has_any, new_const, tree.const)
     return TreeBatch(tree.arity, tree.op, tree.feat, const, tree.length), jnp.bool_(True)
+
+
+def mutate_parameter_row(key, params, temperature, ctx: MutationContext):
+    """Scale one whole parameter row (all classes) by a mutate factor
+    (parametric mutate_constant branch,
+    /root/reference/src/ParametricExpression.jl:173-191).
+
+    ``params``: [n_params, n_classes]. No-op when there are no parameters.
+    """
+    if params.shape[-2] == 0:
+        return params
+    k1, k2 = jax.random.split(key)
+    row = randint_dyn(k1, params.shape[-2])
+    factor = _mutate_factor(k2, temperature, ctx, params.dtype)
+    return params.at[row, :].multiply(factor)
 
 
 def mutate_operator(key, tree: TreeBatch, ctx: MutationContext):
@@ -173,26 +190,49 @@ def delete_node(key, tree: TreeBatch, ctx: MutationContext, structure=None):
     return _select_tree(has_any, new_tree, tree), ok | ~has_any
 
 
+def _sample_leaf(keys, ctx: MutationContext, dtype):
+    """(op_code, feat, const) of one random leaf.
+
+    Non-parametric: 50/50 constant ~ randn / variable ~ uniform feature
+    (src/MutationFunctions.jl:321-333). Parametric (n_params > 0): uniform
+    thirds constant / variable / parameter
+    (make_random_leaf for ParametricNode,
+    /root/reference/src/ParametricExpression.jl:113-137).
+    """
+    val = jax.random.normal(keys[1], dtype=dtype)
+    f = randint_dyn(keys[2], ctx.nfeatures)
+    if ctx.n_params > 0:
+        choice = randint_dyn(keys[0], 3)
+        p = randint_dyn(keys[3], ctx.n_params)
+        code = jnp.where(
+            choice == 0, LEAF_CONST, jnp.where(choice == 1, LEAF_VAR, LEAF_PARAM)
+        )
+        is_const = choice == 0
+        feat = jnp.where(choice == 1, f, jnp.where(choice == 2, p, 0))
+    else:
+        is_const = jax.random.bernoulli(keys[0])
+        code = jnp.where(is_const, LEAF_CONST, LEAF_VAR)
+        feat = jnp.where(is_const, 0, f)
+    return code, feat, jnp.where(is_const, val, jnp.zeros((), dtype))
+
+
 def _make_leaf_scratch(key, n_slots, ctx: MutationContext, dtype):
     """Scratch arrays holding `n_slots` random leaves + one op slot.
 
-    Layout: slots [0..MAX_ARITY-1] are random leaves (50/50 constant ~
-    randn / variable ~ uniform feature, src/MutationFunctions.jl:321-333);
-    slot MAX_ARITY is reserved for a new operator node written by callers.
+    Layout: slots [0..MAX_ARITY-1] are random leaves (_sample_leaf); slot
+    MAX_ARITY is reserved for a new operator node written by callers.
     """
     S = MAX_ARITY + 1
-    keys = jax.random.split(key, MAX_ARITY * 3)
+    keys = jax.random.split(key, MAX_ARITY * 4)
     arity = jnp.zeros((S,), jnp.int32)
     op = jnp.zeros((S,), jnp.int32)
     feat = jnp.zeros((S,), jnp.int32)
     const = jnp.zeros((S,), dtype)
     for j in range(MAX_ARITY):
-        is_const = jax.random.bernoulli(keys[3 * j])
-        val = jax.random.normal(keys[3 * j + 1], dtype=dtype)
-        f = randint_dyn(keys[3 * j + 2], ctx.nfeatures)
-        op = op.at[j].set(jnp.where(is_const, LEAF_CONST, LEAF_VAR))
-        feat = feat.at[j].set(jnp.where(is_const, 0, f))
-        const = const.at[j].set(jnp.where(is_const, val, 0.0))
+        code, fj, cj = _sample_leaf(keys[4 * j:4 * j + 4], ctx, dtype)
+        op = op.at[j].set(code)
+        feat = feat.at[j].set(fj)
+        const = const.at[j].set(cj)
     return arity, op, feat, const
 
 
@@ -404,20 +444,14 @@ def crossover_trees(key, tree1: TreeBatch, tree2: TreeBatch, ctx: MutationContex
 
 
 def _make_single_leaf(key, ctx: MutationContext, dtype):
-    k1, k2, k3 = jax.random.split(key, 3)
-    is_const = jax.random.bernoulli(k1)
+    keys = jax.random.split(key, 4)
+    code, f0, c0 = _sample_leaf(keys, ctx, dtype)
     L = ctx.max_nodes
     t = TreeBatch(
         arity=jnp.zeros((L,), jnp.int32),
-        op=jnp.zeros((L,), jnp.int32).at[0].set(
-            jnp.where(is_const, LEAF_CONST, LEAF_VAR)
-        ),
-        feat=jnp.zeros((L,), jnp.int32).at[0].set(
-            jnp.where(is_const, 0, randint_dyn(k2, ctx.nfeatures))
-        ),
-        const=jnp.zeros((L,), dtype).at[0].set(
-            jnp.where(is_const, jax.random.normal(k3, dtype=dtype), 0.0)
-        ),
+        op=jnp.zeros((L,), jnp.int32).at[0].set(code),
+        feat=jnp.zeros((L,), jnp.int32).at[0].set(f0),
+        const=jnp.zeros((L,), dtype).at[0].set(c0),
         length=jnp.int32(1),
     )
     return t

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from ..ops.encoding import TreeBatch
 from .mutation import MutationContext, gen_random_tree
 
-__all__ = ["PopulationState", "init_population"]
+__all__ = ["PopulationState", "init_population", "init_params", "zero_params"]
 
 
 @jax.tree_util.register_dataclass
@@ -32,10 +32,18 @@ class PopulationState:
                             # src/Utils.jl:14-24)
     ref: jax.Array          # [..., P] int32 lineage id
     parent: jax.Array       # [..., P] int32 parent lineage id
+    # Per-member parameter banks [..., P, n_params, n_classes]
+    # (ParametricExpression, /root/reference/src/ParametricExpression.jl:35-51);
+    # zero-sized (n_params == 0) for plain expressions.
+    params: jax.Array
 
     @property
     def pop_size(self) -> int:
         return self.cost.shape[-1]
+
+    @property
+    def n_params(self) -> int:
+        return self.params.shape[-2]
 
     def member(self, idx) -> "PopulationState":
         """Gather a single member (or indexed subset) along the member axis."""
@@ -55,7 +63,20 @@ class PopulationState:
             birth=take(self.birth),
             ref=take(self.ref),
             parent=take(self.parent),
+            params=jnp.take(self.params, idx, axis=-3),
         )
+
+
+def zero_params(batch_shape, n_params: int, n_classes: int, dtype) -> jax.Array:
+    return jnp.zeros((*batch_shape, n_params, n_classes), dtype)
+
+
+def init_params(key, batch_shape, n_params: int, n_classes: int, dtype) -> jax.Array:
+    """randn-initialized parameter banks (extra_init_params,
+    /root/reference/src/ParametricExpression.jl:35-51)."""
+    if n_params == 0:
+        return zero_params(batch_shape, n_params, n_classes, dtype)
+    return jax.random.normal(key, (*batch_shape, n_params, n_classes), dtype)
 
 
 def init_population(

@@ -79,6 +79,10 @@ class EvolveConfig(NamedTuple):
     # when the dataset carries units), and whether constants are wildcards.
     dim_penalty: float = 1000.0
     wildcard_constants: bool = True
+    # Parametric expressions (ParametricExpressionSpec): per-member
+    # parameter banks [n_params, n_classes]; 0 = plain expressions.
+    n_params: int = 0
+    n_classes: int = 0
 
     @property
     def n_slots(self) -> int:
@@ -93,16 +97,20 @@ class EvolveConfig(NamedTuple):
             max_nodes=self.max_nodes,
             perturbation_factor=self.perturbation_factor,
             probability_negate_constant=self.probability_negate_constant,
+            n_params=self.n_params,
         )
 
 
-def evolve_config_from_options(options: Options, nfeatures: int) -> EvolveConfig:
+def evolve_config_from_options(options: Options, nfeatures: int,
+                               n_params: int = 0, n_classes: int = 0) -> EvolveConfig:
     on_tpu = jax.default_backend() == "tpu"
     turbo = options.turbo if options.turbo is not None else on_tpu
     if turbo and not supports_fused_eval(options.operators):
         turbo = False
     if options.loss_function is not None or options.loss_function_expression is not None:
         turbo = False  # custom whole-prediction losses use the jnp path
+    if n_params > 0:
+        turbo = False  # parameter-leaf gather uses the jnp interpreter
     return EvolveConfig(
         operators=options.operators,
         maxsize=options.maxsize,
@@ -135,6 +143,8 @@ def evolve_config_from_options(options: Options, nfeatures: int) -> EvolveConfig
             else 1000.0  # src/LossFunctions.jl:236-245 default
         ),
         wildcard_constants=not options.dimensionless_constants_only,
+        n_params=n_params,
+        n_classes=n_classes,
     )
 
 
@@ -173,9 +183,12 @@ def _condition_weights(base_w, tree: TreeBatch, complexity, cur_maxsize,
              jnp.where(root_is_leaf & root_is_const, zero, w[_KIND["mutate_feature"]]))
     w = setw(w, "swap_operands",
              jnp.where(~has_binary, zero, w[_KIND["swap_operands"]]))
-    # constant-count scaling (condition_mutate_constant!, :159-170)
-    w = setw(w, "mutate_constant",
-             w[_KIND["mutate_constant"]] * jnp.minimum(8, n_const) / 8.0)
+    # constant-count scaling (condition_mutate_constant!, :159-170);
+    # parametric expressions skip it (the parametric overload is a no-op,
+    # /root/reference/src/ParametricExpression.jl:101-112)
+    if cfg.n_params == 0:
+        w = setw(w, "mutate_constant",
+                 w[_KIND["mutate_constant"]] * jnp.minimum(8, n_const) / 8.0)
     if cfg.nfeatures <= 1:
         w = setw(w, "mutate_feature", zero)
     too_big = complexity >= cur_maxsize
@@ -249,23 +262,37 @@ def _check_single(tree: TreeBatch, options, tables, cur_maxsize):
 
 
 def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
-                    operators, parsimony, batch_idx=None, params=None,
+                    operators, parsimony, batch_idx=None, member_params=None,
                     turbo=False, interpret=False, loss_function=None,
                     dim_penalty=1000.0, wildcard_constants=True):
     """Batched eval_cost (src/LossFunctions.jl:193-209): (cost, loss, complexity).
 
     ``turbo`` routes through the fused Pallas eval+loss kernel (the hot
     path); params (parametric expressions) and grad paths use the jnp
-    interpreter.
+    interpreter. ``member_params``: per-tree parameter banks
+    [..., n_params, n_classes], expanded to per-row values via the
+    dataset's class column (eval_tree_dispatch for ParametricExpression,
+    /root/reference/src/ParametricExpression.jl:88-100).
     """
     if batch_idx is None:
         X = data.Xt
         y = data.y
         w = data.weights
+        class_idx = data.class_idx
     else:
         X = jnp.take(data.Xt, batch_idx, axis=1)
         y = jnp.take(data.y, batch_idx)
         w = None if data.weights is None else jnp.take(data.weights, batch_idx)
+        class_idx = (
+            None if data.class_idx is None else jnp.take(data.class_idx, batch_idx)
+        )
+    params = None
+    if member_params is not None and member_params.shape[-2] > 0:
+        if class_idx is None:
+            raise ValueError(
+                "Parametric evaluation requires a `class` column in the dataset"
+            )
+        params = jnp.take(member_params, class_idx, axis=-1)  # [..., K, n]
     if turbo and params is None and loss_function is None:
         loss, valid = fused_loss(
             trees, X, y, w, operators, elementwise_loss, interpret=interpret
@@ -379,6 +406,22 @@ def generation_step(
         att_valid = att_ok & att_cons
         mut_tree, mut_success = _first_valid(att_valid, att_trees, m1.trees)
 
+        # Parametric: mutate_constant takes the parameter-row branch half
+        # the time, leaving the tree untouched
+        # (/root/reference/src/ParametricExpression.jl:173-191).
+        mut_params = m1.params
+        if cfg.n_params > 0:
+            kp1, kp2 = jax.random.split(ks[7])
+            mutate_param = (
+                (kind == _KIND["mutate_constant"]) & jax.random.bernoulli(kp1)
+            )
+            new_params = M.mutate_parameter_row(
+                kp2, m1.params, temperature, cfg.mctx
+            )
+            mut_params = jnp.where(mutate_param, new_params, m1.params)
+            mut_tree = M._select_tree(mutate_param, m1.trees, mut_tree)
+            mut_success = mut_success | mutate_param
+
         # ---- crossover path ----
         xa_keys = jax.random.split(ks[5], A)
         c1s, c2s, ok1s, ok2s = jax.vmap(
@@ -396,23 +439,31 @@ def generation_step(
 
         cand1 = M._select_tree(is_xover, xo1, mut_tree)
         cand2 = xo2
+        # Crossover exchanges the whole parameter banks (the reference
+        # swaps every row, /root/reference/src/ParametricExpression.jl:139-167).
+        cand1_params = jnp.where(is_xover, m2.params, mut_params)
+        cand2_params = m1.params
         needs_eval1 = jnp.where(is_xover, xo_success, mut_success & ~immediate)
         needs_eval2 = is_xover & xo_success
         return (
             is_xover, i1, i2, kind, immediate, mut_success, xo_success,
-            cand1, cand2, needs_eval1, needs_eval2, ks[6],
+            cand1, cand2, cand1_params, cand2_params,
+            needs_eval1, needs_eval2, ks[6],
         )
 
     (is_xover, i1, i2, kind, immediate, mut_success, xo_success,
-     cand1, cand2, needs_eval1, needs_eval2, accept_keys) = jax.vmap(slot_fn)(keys)
+     cand1, cand2, cand1_params, cand2_params,
+     needs_eval1, needs_eval2, accept_keys) = jax.vmap(slot_fn)(keys)
 
     # ---- one fused eval launch over all candidates ----
     both = jax.tree.map(
         lambda a, b: jnp.stack([a, b], axis=1), cand1, cand2
     )  # [B, 2, ...]
+    both_params = jnp.stack([cand1_params, cand2_params], axis=1)  # [B,2,K,C]
     cost, loss, complexity = eval_cost_batch(
         both, data, elementwise_loss, tables, cfg.operators, cfg.parsimony,
-        batch_idx=batch_idx, turbo=cfg.turbo, interpret=cfg.interpret,
+        batch_idx=batch_idx, member_params=both_params,
+        turbo=cfg.turbo, interpret=cfg.interpret,
         loss_function=options.resolved_loss_function,
         dim_penalty=cfg.dim_penalty, wildcard_constants=cfg.wildcard_constants,
     )
@@ -449,12 +500,15 @@ def generation_step(
         immediate, jnp.bool_(True),
         jnp.where(accepted_mut, True, ~jnp.bool_(cfg.skip_mutation_failures)),
     )
-    baby1_tree = M._select_tree(
-        accepted_mut & ~immediate, cand1, pop.member(i1).trees
+    m1_params = pop.member(i1).params
+    accept1 = accepted_mut & ~immediate
+    baby1_tree = M._select_tree(accept1, cand1, pop.member(i1).trees)
+    baby1_params = jnp.where(
+        accept1.reshape(accept1.shape + (1, 1)), cand1_params, m1_params
     )
-    baby1_cost = jnp.where(accepted_mut & ~immediate, after_cost, m1_cost)
-    baby1_loss = jnp.where(accepted_mut & ~immediate, after_loss, m1_loss)
-    baby1_cx = jnp.where(accepted_mut & ~immediate, after_cx, m1_complexity)
+    baby1_cost = jnp.where(accept1, after_cost, m1_cost)
+    baby1_loss = jnp.where(accept1, after_loss, m1_loss)
+    baby1_cx = jnp.where(accept1, after_cx, m1_complexity)
 
     # Crossover babies replace unconditionally when constraints passed
     # (crossover_generation, src/Mutate.jl:661-733).
@@ -464,11 +518,15 @@ def generation_step(
     replace1 = jnp.where(is_xover, xo_replace, mut_replace)
     replace2 = is_xover & xo_replace
     baby1_tree = M._select_tree(is_xover, cand1, baby1_tree)
+    baby1_params = jnp.where(
+        is_xover.reshape(is_xover.shape + (1, 1)), cand1_params, baby1_params
+    )
     baby1_cost = jnp.where(is_xover, cost[:, 0], baby1_cost)
     baby1_loss = jnp.where(is_xover, loss[:, 0], baby1_loss)
     baby1_cx = jnp.where(is_xover, complexity[:, 0], baby1_cx)
 
     babies = jax.tree.map(lambda a, b: jnp.stack([a, b], axis=1), baby1_tree, cand2)
+    baby_params = jnp.stack([baby1_params, cand2_params], axis=1)  # [B,2,K,C]
     baby_cost = jnp.stack([baby1_cost, cost[:, 1]], axis=1)
     baby_loss = jnp.stack([baby1_loss, loss[:, 1]], axis=1)
     baby_cx = jnp.stack([baby1_cx, complexity[:, 1]], axis=1)
@@ -505,6 +563,9 @@ def generation_step(
         birth=scatter(pop.birth, new_birth),
         ref=scatter(pop.ref, new_ref),
         parent=scatter(pop.parent, baby_parent.reshape(-1)),
+        params=scatter(
+            pop.params, baby_params.reshape(nb, *baby_params.shape[2:])
+        ),
     )
     return new_pop, num_evals, birth0 + nb, ref0 + nb
 
@@ -522,15 +583,18 @@ class HofState:
     loss: jax.Array       # [..., maxsize]
     complexity: jax.Array  # [..., maxsize] int32
     exists: jax.Array     # [..., maxsize] bool
+    params: jax.Array     # [..., maxsize, n_params, n_classes]
 
 
-def empty_hof(maxsize: int, max_nodes: int, dtype) -> HofState:
+def empty_hof(maxsize: int, max_nodes: int, dtype,
+              n_params: int = 0, n_classes: int = 0) -> HofState:
     return HofState(
         trees=TreeBatch.empty((maxsize,), max_nodes, dtype),
         cost=jnp.full((maxsize,), jnp.inf, dtype),
         loss=jnp.full((maxsize,), jnp.inf, dtype),
         complexity=jnp.zeros((maxsize,), jnp.int32),
         exists=jnp.zeros((maxsize,), jnp.bool_),
+        params=jnp.zeros((maxsize, n_params, n_classes), dtype),
     )
 
 
@@ -562,6 +626,7 @@ def update_hof(hof: HofState, pop: PopulationState, maxsize: int) -> HofState:
         loss=pick(hof.loss, pop.loss),
         complexity=pick(hof.complexity, pop.complexity),
         exists=hof.exists | better,
+        params=pick(hof.params, pop.params),
     )
 
 
@@ -582,7 +647,8 @@ def s_r_cycle(
     """ncycles generation steps over the annealing ramp; returns
     (pop, best_seen_hof, num_evals, birth0, ref0)."""
     ncycles = cfg.ncycles
-    hof0 = empty_hof(cfg.maxsize, cfg.max_nodes, pop.cost.dtype)
+    hof0 = empty_hof(cfg.maxsize, cfg.max_nodes, pop.cost.dtype,
+                     cfg.n_params, cfg.n_classes)
 
     def cycle(carry, c):
         pop, hof, birth, ref, nev = carry

@@ -1,0 +1,9 @@
+"""Expression-family plugin layer (TPU analogue of the reference's L5,
+SURVEY.md §2.5): expression specs and parametric expressions."""
+
+from .spec import ExpressionSpec, ParametricExpressionSpec
+
+__all__ = [
+    "ExpressionSpec",
+    "ParametricExpressionSpec",
+]

@@ -1,0 +1,42 @@
+"""Expression specifications: which expression family the search evolves.
+
+TPU analogue of the reference's AbstractExpressionSpec layer
+(/root/reference/src/ExpressionSpec.jl:5-20): a spec selects the
+(expression_type, expression_options, node_type) triple. Here a spec
+selects the population-tensor layout extensions (e.g. per-member
+parameter banks) and the eval dispatch.
+
+- ``ExpressionSpec``            — plain expression trees (default).
+- ``ParametricExpressionSpec``  — trees with parameter leaves ``p1..pK``
+  whose values form a per-member (max_parameters × num_classes) matrix,
+  indexed by the dataset's ``class`` column
+  (/root/reference/src/ParametricExpression.jl:35-51).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["ExpressionSpec", "ParametricExpressionSpec"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpressionSpec:
+    """Default spec: plain expression trees (src/ExpressionSpec.jl:16-20)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ParametricExpressionSpec(ExpressionSpec):
+    """Spec for parametric expressions with per-class parameters
+    (ParametricExpressionSpec, /root/reference/src/ParametricExpression.jl:203-233).
+
+    The dataset must carry a ``class`` column in ``extra``; each member
+    owns a ``(max_parameters, num_classes)`` parameter matrix. Parameter
+    leaves evaluate to ``parameters[p, class[row]]``.
+    """
+
+    max_parameters: int = 2
+
+    def __post_init__(self):
+        if self.max_parameters < 1:
+            raise ValueError("max_parameters must be >= 1")

@@ -142,7 +142,13 @@ def _single_tree_violation(
         is_const_leaf = (o == LEAF_CONST) | (o == LEAF_PARAM)
         x_val = jax.lax.dynamic_index_in_dim(x_sample, feat[k], 0, keepdims=False)
         xd = jax.lax.dynamic_index_in_dim(x_dims, feat[k], 0, keepdims=False)
-        leaf_val = jnp.where(is_const_leaf, const[k].astype(jnp.float32), x_val)
+        # Parameter leaves have no single value at dims-check time (one per
+        # class): NaN marks the value unknown, which propagates through
+        # _node_value and makes any pow using it wildcard below.
+        leaf_val = jnp.where(
+            o == LEAF_PARAM, jnp.float32(jnp.nan),
+            jnp.where(is_const_leaf, const[k].astype(jnp.float32), x_val),
+        )
         leaf_dims = jnp.where(is_const_leaf, jnp.zeros((N_DIMS,), jnp.float32), xd)
         leaf_wild = is_const_leaf & jnp.bool_(wildcard_constants)
 
@@ -170,7 +176,10 @@ def _single_tree_violation(
         add_viol = ~c0w & ~c1w & ~_dims_match(c0d, c1d)
         mul_dims = c0d + c1d
         div_dims = c0d - c1d
-        pow_dims = c0d * c1v
+        # Unknown exponent value (NaN, e.g. a parameter leaf): the output
+        # dims base^t are undetermined — treat as wildcard, never violate.
+        exp_unknown = jnp.isnan(c1v)
+        pow_dims = c0d * jnp.where(exp_unknown, 0.0, c1v)
         pow_viol = ~c1w & ~_dimless(c1d)
         gen_viol = (~c0w & ~_dimless(c0d)) | (~c1w & ~_dimless(c1d))
 
@@ -183,7 +192,7 @@ def _single_tree_violation(
         b_wild = jnp.select(
             [bc == B_ADD, bc == B_MUL, bc == B_DIV, bc == B_POW,
              bc == B_COND],
-            [both_wild, either_wild, either_wild, c0w, c1w],
+            [both_wild, either_wild, either_wild, c0w | exp_unknown, c1w],
             jnp.bool_(False),
         )
         b_viol = jnp.select(

@@ -1,0 +1,175 @@
+"""ParametricExpression support: per-class parameter banks.
+
+Mirrors the reference's parametric tests (test/unit/… parametric cases and
+test/integration/ext/mlj/parametric_search): eval with class-gathered
+parameters, search recovering per-class offsets, regressor round trip.
+Reference behavior: /root/reference/src/ParametricExpression.jl.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.models import ParametricExpressionSpec
+from symbolicregression_jl_tpu.ops.encoding import encode_population
+from symbolicregression_jl_tpu.ops.eval import eval_tree_batch
+from symbolicregression_jl_tpu.ops.operators import OperatorSet
+from symbolicregression_jl_tpu.ops.tree import Node, parse_expression, string_tree
+
+
+@pytest.fixture(scope="module")
+def ops():
+    return OperatorSet(binary_operators=["+", "*"], unary_operators=["cos"])
+
+
+def test_parameter_leaf_eval(ops):
+    # p1 + x1 * p2 over 3 classes
+    tree = parse_expression("p1 + (x1 * p2)", ops)
+    enc = encode_population([tree], 8, ops)
+    n = 6
+    X = np.linspace(-1, 1, n).astype(np.float32)[None, :]  # [F=1, n]
+    cls = np.array([0, 1, 2, 0, 1, 2])
+    params = np.array([[0.5, -1.0, 2.0], [1.0, 2.0, 3.0]], np.float32)  # [K=2, C=3]
+    p_rows = jnp.asarray(params[:, cls])[None]  # [1, K, n]
+    y, valid = eval_tree_batch(enc, jnp.asarray(X), ops, params=p_rows)
+    expected = params[0, cls] + X[0] * params[1, cls]
+    np.testing.assert_allclose(np.asarray(y[0]), expected, rtol=1e-6)
+    assert bool(valid[0])
+
+
+def test_parameter_leaf_without_params_is_invalid(ops):
+    tree = parse_expression("p1 + x1", ops)
+    enc = encode_population([tree], 8, ops)
+    X = jnp.ones((1, 4), jnp.float32)
+    y, valid = eval_tree_batch(enc, X, ops)
+    assert not bool(valid[0])
+
+
+def test_parameter_string_and_parse_roundtrip(ops):
+    tree = Node(op=ops.binary[0], children=[Node.param(0), Node.var(1)])
+    s = string_tree(tree)
+    assert "p1" in s
+    back = parse_expression(s, ops)
+    assert back == tree
+
+
+def test_parametric_search_recovers_per_class_offsets():
+    rng = np.random.default_rng(0)
+    n = 128
+    X = rng.uniform(-2, 2, (n, 2)).astype(np.float32)
+    cls = rng.integers(0, 3, n)
+    offsets = np.array([0.5, -1.0, 2.0])
+    y = (X[:, 0] * 1.5 + offsets[cls]).astype(np.float32)
+
+    from symbolicregression_jl_tpu.api.search import equation_search
+
+    opts = Options(
+        binary_operators=["+", "*"], unary_operators=[],
+        maxsize=8, populations=2, population_size=12,
+        ncycles_per_iteration=10, tournament_selection_n=4,
+        expression_spec=ParametricExpressionSpec(max_parameters=1),
+        optimizer_probability=0.5, optimizer_iterations=4,
+        save_to_file=False,
+    )
+    hof = equation_search(
+        X, y, options=opts, niterations=4, verbosity=0, seed=0,
+        extra={"class": cls},
+    )
+    best = min(hof.entries, key=lambda e: e.loss)
+    assert best.loss < 0.05
+    assert best.params is not None and best.params.shape == (1, 3)
+
+
+def test_parametric_search_requires_class_column():
+    from symbolicregression_jl_tpu.api.search import equation_search
+
+    opts = Options(
+        binary_operators=["+"], unary_operators=[], maxsize=8,
+        populations=2, population_size=8, ncycles_per_iteration=2,
+        tournament_selection_n=4,
+        expression_spec=ParametricExpressionSpec(max_parameters=1),
+        save_to_file=False,
+    )
+    X = np.ones((8, 1), np.float32)
+    y = np.ones((8,), np.float32)
+    with pytest.raises(ValueError, match="class"):
+        equation_search(X, y, options=opts, niterations=1, verbosity=0)
+
+
+def test_parametric_regressor_fit_predict():
+    from symbolicregression_jl_tpu.api.regressor import SRRegressor
+
+    rng = np.random.default_rng(1)
+    n = 96
+    X = rng.uniform(-2, 2, (n, 1)).astype(np.float32)
+    cls = rng.integers(0, 2, n)
+    offsets = np.array([1.0, -2.0])
+    y = (2.0 * X[:, 0] + offsets[cls]).astype(np.float32)
+
+    model = SRRegressor(
+        niterations=4,
+        binary_operators=["+", "*"], unary_operators=[],
+        maxsize=8, populations=2, population_size=12,
+        ncycles_per_iteration=10, tournament_selection_n=4,
+        expression_spec=ParametricExpressionSpec(max_parameters=1),
+        optimizer_probability=0.5, optimizer_iterations=4,
+        save_to_file=False, seed=0,
+    )
+    model.fit(X, y, category=cls)
+    pred = model.predict(X, category=cls)
+    assert np.mean((pred - y) ** 2) < 0.1
+    # predict without category must fail when best equation is parametric
+    if model.get_best().params is not None:
+        with pytest.raises(ValueError, match="category"):
+            model.predict(X)
+
+
+def test_mutation_context_samples_parameter_leaves():
+    from symbolicregression_jl_tpu.evolve.mutation import (
+        MutationContext, gen_random_tree_fixed_size,
+    )
+    from symbolicregression_jl_tpu.ops.encoding import LEAF_PARAM
+
+    ctx = MutationContext(
+        nops=(1, 2), nfeatures=2, max_nodes=16,
+        perturbation_factor=0.1, probability_negate_constant=0.01,
+        n_params=2,
+    )
+    found_param = False
+    for s in range(20):
+        t = gen_random_tree_fixed_size(jax.random.PRNGKey(s), 9, ctx, jnp.float32)
+        arity = np.asarray(t.arity)
+        op = np.asarray(t.op)
+        ln = int(t.length)
+        leaf_param = (arity[:ln] == 0) & (op[:ln] == LEAF_PARAM)
+        if leaf_param.any():
+            found_param = True
+            # parameter indices within range
+            feat = np.asarray(t.feat)[:ln][leaf_param]
+            assert (feat >= 0).all() and (feat < 2).all()
+            break
+    assert found_param
+
+
+def test_parameter_row_mutation():
+    from symbolicregression_jl_tpu.evolve.mutation import (
+        MutationContext, mutate_parameter_row,
+    )
+
+    ctx = MutationContext(
+        nops=(1, 2), nfeatures=2, max_nodes=16,
+        perturbation_factor=0.5, probability_negate_constant=0.0,
+        n_params=3,
+    )
+    params = jnp.ones((3, 4), jnp.float32)
+    out = mutate_parameter_row(
+        jax.random.PRNGKey(0), params, jnp.float32(1.0), ctx
+    )
+    out = np.asarray(out)
+    changed_rows = np.unique(np.where(out != 1.0)[0])
+    assert changed_rows.shape[0] == 1  # exactly one row scaled
+    row = out[changed_rows[0]]
+    assert np.allclose(row, row[0])  # whole row scaled by one factor
