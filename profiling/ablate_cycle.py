@@ -1,0 +1,141 @@
+"""Ablate one evolution cycle: where does per-cycle time go?
+
+Builds scan-of-N programs (all inside one jit, like s_r_cycle) for:
+  full   — the real generation_step
+  noeval — generation_step with the eval replaced by a dummy loss
+  evalo  — eval-only (fused kernel on the same candidate count)
+  struct — tree_structure_arrays on the attempt batch only
+
+Run: python profiling/ablate_cycle.py [islands] [ncycles]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    I = int(sys.argv[1]) if len(sys.argv) > 1 else 15
+    NC = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    P = int(sys.argv[3]) if len(sys.argv) > 3 else 33
+    ATT = int(sys.argv[4]) if len(sys.argv) > 4 else 5
+
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+    from symbolicregression_jl_tpu.evolve import step as S
+    from symbolicregression_jl_tpu.ops.encoding import tree_structure_arrays
+    from symbolicregression_jl_tpu.ops.fused_eval import fused_loss
+
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["exp", "abs", "cos"],
+        maxsize=30,
+        populations=I,
+        population_size=P,
+        ncycles_per_iteration=NC,
+        mutation_attempts=ATT,
+        save_to_file=False,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-3.0, 3.0, (10_000, 5)).astype(np.float32)
+    y = np.cos(2.13 * X[:, 0]).astype(np.float32)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+    engine = Engine(options, ds.nfeatures)
+    cfg = engine.cfg
+    print(f"I={I} P={cfg.population_size} slots={cfg.n_slots} "
+          f"attempts={cfg.attempts} NC={NC} turbo={cfg.turbo}")
+
+    state = engine.init_state(jax.random.PRNGKey(0), ds.data, I)
+    pops = state.pops
+    nf = state.stats.normalized_frequencies
+
+    def one_cycle(pop, c, eval_dummy=False):
+        k = jax.random.fold_in(jax.random.PRNGKey(1), c)
+        ev = S.eval_cost_batch
+        if eval_dummy:
+            def ev(trees, data, *a, **kw):
+                # same shapes, trivial compute
+                cost = jnp.sum(trees.const, axis=-1)
+                return cost, cost, jnp.sum(trees.arity, axis=-1)
+        orig = S.eval_cost_batch
+        S.eval_cost_batch = ev
+        try:
+            def isl(kk, p, b, r):
+                return S.generation_step(
+                    kk, p, ds.data, nf, jnp.float32(1.0),
+                    jnp.int32(30), b, r, cfg, options, engine.tables,
+                    options.elementwise_loss)
+            keys = jax.random.split(k, I)
+            newpop, nev, b, r = jax.vmap(isl)(
+                keys, pop, jnp.zeros((I,), jnp.int32), jnp.zeros((I,), jnp.int32))
+        finally:
+            S.eval_cost_batch = orig
+        return newpop
+
+    def make_scan(eval_dummy):
+        def prog(pop):
+            def body(p, c):
+                return one_cycle(p, c, eval_dummy), None
+            pop, _ = jax.lax.scan(body, pop, jnp.arange(NC))
+            return pop
+        return jax.jit(prog)
+
+    def time_prog(f, arg):
+        out = f(arg)
+        jax.block_until_ready(jax.tree.leaves(out)[0])
+        t0 = time.perf_counter()
+        out = f(arg)
+        jax.block_until_ready(jax.tree.leaves(out)[0])
+        return (time.perf_counter() - t0) / NC
+
+    t_full = time_prog(make_scan(False), pops)
+    t_noev = time_prog(make_scan(True), pops)
+    print(f"full cycle:   {t_full*1e3:8.2f} ms")
+    print(f"no-eval:      {t_noev*1e3:8.2f} ms")
+
+    # eval-only scan on same candidate count (I * slots * 2 trees)
+    T = I * cfg.n_slots * 2
+    from symbolicregression_jl_tpu.evolve.population import init_population
+    trees = init_population(jax.random.PRNGKey(0), T, cfg.mctx, jnp.float32)
+
+    def eval_prog(tr):
+        def body(t, c):
+            loss, valid = fused_loss(
+                t, ds.data.Xt, ds.data.y, None, cfg.operators,
+                options.elementwise_loss, interpret=cfg.interpret)
+            eps = jnp.nanmin(jnp.where(jnp.isfinite(loss), loss, jnp.inf))
+            return dataclasses.replace(t, const=t.const + eps * 1e-12), None
+        t, _ = jax.lax.scan(body, tr, jnp.arange(NC))
+        return t
+    t_eval = time_prog(jax.jit(eval_prog), trees)
+    print(f"eval-only({T}): {t_eval*1e3:8.2f} ms")
+
+    # structure-derivation-only scan on the attempt batch [I*slots*A]
+    TA = I * cfg.n_slots * cfg.attempts
+    atrees = init_population(jax.random.PRNGKey(1), TA, cfg.mctx, jnp.float32)
+
+    def struct_prog(tr):
+        def body(t, c):
+            ch, sz, dp = tree_structure_arrays(t)
+            return dataclasses.replace(
+                t, feat=jnp.clip(t.feat + sz % 2, 0, 4)), None
+        t, _ = jax.lax.scan(body, tr, jnp.arange(NC))
+        return t
+    t_struct = time_prog(jax.jit(struct_prog), atrees)
+    print(f"struct-only({TA}): {t_struct*1e3:8.2f} ms (one of ~3 calls/cycle)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Throughput measurement with forced dependency chains.
+
+Each launch's input depends on the previous launch's output, so the
+device must execute them sequentially; host queues all launches and
+blocks once. This amortizes the tunnel round-trip latency and defeats
+any caching of identical executions.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    T = int(sys.argv[1])
+    TB = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    TILE = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+    N_CHAIN = int(sys.argv[4]) if len(sys.argv) > 4 else 30
+
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+    from symbolicregression_jl_tpu.evolve.population import init_population
+    from symbolicregression_jl_tpu.ops.fused_eval import fused_loss
+
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["exp", "abs", "cos"],
+        maxsize=30,
+        save_to_file=False,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-3.0, 3.0, (10_000, 5)).astype(np.float32)
+    y = np.cos(2.13 * X[:, 0]).astype(np.float32)
+    ds = make_dataset(X, y)
+    engine = Engine(options, ds.nfeatures)
+    cfg = engine.cfg
+
+    trees = init_population(jax.random.PRNGKey(0), T, cfg.mctx, jnp.float32)
+
+    @jax.jit
+    def step(tr):
+        loss, valid = fused_loss(
+            tr, ds.data.Xt, ds.data.y, None, cfg.operators,
+            options.elementwise_loss, tree_block=TB, tile_rows=TILE,
+            interpret=cfg.interpret)
+        # feed a loss-derived epsilon back into consts -> data dependency
+        eps = jnp.nanmin(jnp.where(jnp.isfinite(loss), loss, jnp.inf)) * 1e-12
+        import dataclasses
+        return dataclasses.replace(tr, const=tr.const + eps)
+
+    tr = step(trees)  # compile
+    jax.block_until_ready(tr.const)
+
+    t0 = time.perf_counter()
+    for _ in range(N_CHAIN):
+        tr = step(tr)
+    jax.block_until_ready(tr.const)
+    dt = (time.perf_counter() - t0) / N_CHAIN
+    print(f"T={T} TB={TB} TILE={TILE}: {dt*1e3:.3f} ms/launch  "
+          f"{T/dt:.0f} ev/s")
+
+
+if __name__ == "__main__":
+    main()

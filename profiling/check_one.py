@@ -1,0 +1,63 @@
+"""Time one fused config in a fresh process and validate the result."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    T = int(sys.argv[1])
+    TB = int(sys.argv[2])
+    TILE = int(sys.argv[3])
+
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+    from symbolicregression_jl_tpu.evolve.population import init_population
+    from symbolicregression_jl_tpu.ops.fused_eval import fused_loss
+
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["exp", "abs", "cos"],
+        maxsize=30,
+        save_to_file=False,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-3.0, 3.0, (10_000, 5)).astype(np.float32)
+    y = np.cos(2.13 * X[:, 0]).astype(np.float32)
+    ds = make_dataset(X, y)
+    engine = Engine(options, ds.nfeatures)
+    cfg = engine.cfg
+
+    trees = init_population(jax.random.PRNGKey(0), T, cfg.mctx, jnp.float32)
+    f = jax.jit(lambda tr: fused_loss(
+        tr, ds.data.Xt, ds.data.y, None, cfg.operators,
+        options.elementwise_loss, tree_block=TB, tile_rows=TILE,
+        interpret=cfg.interpret))
+    loss, valid = f(trees)
+    jax.block_until_ready(loss)
+    n_valid = int(jnp.sum(valid))
+    mean_finite = float(jnp.nanmean(jnp.where(jnp.isfinite(loss), loss, jnp.nan)))
+
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        out = f(trees)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    t = float(np.median(times))
+    print(f"T={T} TB={TB} TILE={TILE}: {t*1e3:.3f} ms  {T/t:.0f} ev/s  "
+          f"valid={n_valid}/{T} meanloss={mean_finite:.4f}  "
+          f"min={min(times)*1e3:.3f} max={max(times)*1e3:.3f}")
+
+
+if __name__ == "__main__":
+    main()

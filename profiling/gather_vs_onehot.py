@@ -1,0 +1,71 @@
+"""Is batched fancy-index gather the TPU bottleneck vs one-hot contraction?"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def chain_time(f, x, n=50):
+    x = f(x)
+    jax.block_until_ready(x)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        x = f(x)
+    jax.block_until_ready(x)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    B, L, S = 1800, 30, 96
+    key = jax.random.PRNGKey(0)
+    src = jax.random.randint(key, (B, L), 0, S)
+    table = jax.random.normal(jax.random.fold_in(key, 1), (B, S))
+
+    @jax.jit
+    def gather_step(t):
+        out = jnp.take_along_axis(t, jnp.clip(src, 0, S - 1)[:, :L], axis=1)
+        # feed back to keep a chain
+        return t.at[:, :L].add(out * 1e-6)
+
+    @jax.jit
+    def vmap_gather_step(t):
+        out = jax.vmap(lambda row, idx: row[idx])(t, src)
+        return t.at[:, :L].add(out * 1e-6)
+
+    @jax.jit
+    def onehot_step(t):
+        oh = (src[..., None] == jnp.arange(S)).astype(t.dtype)  # [B, L, S]
+        out = jnp.sum(oh * t[:, None, :], axis=-1)
+        return t.at[:, :L].add(out * 1e-6)
+
+    # scatter variants: write one element per row
+    idx1 = jax.random.randint(jax.random.fold_in(key, 2), (B,), 0, L)
+
+    @jax.jit
+    def scatter_step(t):
+        return t.at[jnp.arange(B), idx1].multiply(1.0 + 1e-6)
+
+    @jax.jit
+    def where_step(t):
+        hit = jnp.arange(S) == idx1[:, None]
+        return jnp.where(hit, t * (1.0 + 1e-6), t)
+
+    for name, f in [("take_along_axis", gather_step),
+                    ("vmap row[idx]", vmap_gather_step),
+                    ("onehot mul-reduce", onehot_step),
+                    ("scatter 1/row", scatter_step),
+                    ("where 1/row", where_step)]:
+        t = chain_time(f, table)
+        print(f"{name:20s}: {t*1e6:9.1f} us")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Microbenchmarks for the eval kernels and the iteration hot loop.
+
+Run on the target backend (TPU) to get the breakdown the perf work is
+driven by; results are recorded in profiling/RESULTS.md.
+
+Usage: python profiling/profile_eval.py [--trees 90 512 2048] [--rows 10000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, n=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trees", type=int, nargs="+",
+                    default=[90, 256, 1024, 4096])
+    ap.add_argument("--rows", type=int, default=10_000)
+    ap.add_argument("--maxsize", type=int, default=30)
+    args = ap.parse_args()
+
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+    from symbolicregression_jl_tpu.evolve.population import init_population
+    from symbolicregression_jl_tpu.ops.eval import eval_tree_batch
+    from symbolicregression_jl_tpu.ops.fused_eval import fused_loss
+    from symbolicregression_jl_tpu.core.losses import aggregate_loss
+
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["exp", "abs", "cos"],
+        maxsize=args.maxsize,
+        populations=15,
+        population_size=33,
+        ncycles_per_iteration=100,
+        save_to_file=False,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-3.0, 3.0, (args.rows, 5)).astype(np.float32)
+    y = np.cos(2.13 * X[:, 0]).astype(np.float32)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+    engine = Engine(options, ds.nfeatures)
+    cfg = engine.cfg
+
+    print(f"backend={jax.default_backend()} rows={args.rows} L={args.maxsize}")
+
+    for T in args.trees:
+        key = jax.random.PRNGKey(0)
+        trees = init_population(key, T, cfg.mctx, jnp.float32)
+
+        f_fused = jax.jit(lambda tr: fused_loss(
+            tr, ds.data.Xt, ds.data.y, None, cfg.operators,
+            options.elementwise_loss, interpret=cfg.interpret))
+        t_fused = timeit(f_fused, trees)
+
+        def jnp_loss(tr):
+            pred, valid = eval_tree_batch(tr, ds.data.Xt, cfg.operators)
+            return aggregate_loss(options.elementwise_loss, pred, ds.data.y,
+                                  valid, None)
+        f_jnp = jax.jit(jnp_loss)
+        t_jnp = timeit(f_jnp, trees)
+
+        print(f"T={T:6d}  fused={t_fused*1e3:8.3f} ms ({T/t_fused:10.0f} ev/s)"
+              f"  jnp={t_jnp*1e3:8.3f} ms ({T/t_jnp:10.0f} ev/s)")
+
+    # full iteration breakdown
+    state = engine.init_state(jax.random.PRNGKey(0), ds.data,
+                              options.populations)
+    t_iter = timeit(
+        lambda s: engine.run_iteration(s, ds.data, options.maxsize),
+        state, n=3, warmup=1)
+    evals_per_iter = (options.populations * cfg.n_slots * 2 * cfg.ncycles
+                      + options.populations * options.population_size)
+    print(f"run_iteration: {t_iter*1e3:.1f} ms  "
+          f"(~{evals_per_iter} evals -> {evals_per_iter/t_iter:.0f} ev/s)"
+          f"  per-cycle: {t_iter/cfg.ncycles*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Capture a device trace of the cycle scan and aggregate HLO op times."""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    I = int(sys.argv[1]) if len(sys.argv) > 1 else 40
+    NC = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    P = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["exp", "abs", "cos"],
+        maxsize=30,
+        populations=I,
+        population_size=P,
+        ncycles_per_iteration=NC,
+        save_to_file=False,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-3.0, 3.0, (10_000, 5)).astype(np.float32)
+    y = np.cos(2.13 * X[:, 0]).astype(np.float32)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+    engine = Engine(options, ds.nfeatures)
+
+    state = engine.init_state(jax.random.PRNGKey(0), ds.data, I)
+    state = engine.run_iteration(state, ds.data, options.maxsize)  # compile
+    jax.block_until_ready(state.pops.cost)
+
+    logdir = "/tmp/sr_trace"
+    os.system(f"rm -rf {logdir}")
+    with jax.profiler.trace(logdir):
+        state = engine.run_iteration(state, ds.data, options.maxsize)
+        jax.block_until_ready(state.pops.cost)
+
+    # aggregate trace events
+    files = glob.glob(f"{logdir}/**/*.trace.json.gz", recursive=True)
+    print("trace files:", files)
+    agg = defaultdict(float)
+    total = 0.0
+    for fn in files:
+        with gzip.open(fn, "rt") as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            name = ev.get("name", "")
+            dur = ev.get("dur", 0) / 1e3  # ms
+            pid = ev.get("pid", 0)
+            # keep only device lanes (XLA ops); heuristically skip python
+            args = ev.get("args", {})
+            if "long_name" in args or re.match(
+                r"^(fusion|copy|dynamic|scatter|gather|while|select|"
+                r"convert|broadcast|reduce|transpose|iota|slice|concatenate|"
+                r"dot|cumsum|rng|sort|pad|add|mul|custom|tpu)", name):
+                key = re.sub(r"[.\d]+$", "", name)
+                agg[key] += dur
+                total += dur
+    items = sorted(agg.items(), key=lambda kv: -kv[1])[:40]
+    print(f"total device op time: {total:.1f} ms over {NC} cycles")
+    for k, v in items:
+        print(f"  {v:10.3f} ms  {k}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Sweep (tree_block, tile_rows) for the fused kernel on the bench shape."""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, n=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+    from symbolicregression_jl_tpu.evolve.population import init_population
+    from symbolicregression_jl_tpu.ops.fused_eval import fused_loss
+
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["exp", "abs", "cos"],
+        maxsize=30,
+        save_to_file=False,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-3.0, 3.0, (10_000, 5)).astype(np.float32)
+    y = np.cos(2.13 * X[:, 0]).astype(np.float32)
+    ds = make_dataset(X, y)
+    engine = Engine(options, ds.nfeatures)
+    cfg = engine.cfg
+
+    for T in (96, 1024, 4096):
+        trees = init_population(jax.random.PRNGKey(0), T, cfg.mctx, jnp.float32)
+        for TB, TILE in itertools.product((8, 16, 32), (2048, 5120, 10240)):
+            try:
+                f = jax.jit(lambda tr: fused_loss(
+                    tr, ds.data.Xt, ds.data.y, None, cfg.operators,
+                    options.elementwise_loss, tree_block=TB, tile_rows=TILE,
+                    interpret=cfg.interpret))
+                t = timeit(f, trees)
+                print(f"T={T:5d} TB={TB:3d} TILE={TILE:6d}: "
+                      f"{t*1e3:8.3f} ms  {T/t:10.0f} ev/s")
+            except Exception as e:
+                print(f"T={T:5d} TB={TB:3d} TILE={TILE:6d}: FAIL {type(e).__name__}")
+
+
+if __name__ == "__main__":
+    main()

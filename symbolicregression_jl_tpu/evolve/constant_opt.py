@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from ..core.losses import aggregate_loss, loss_to_cost
 from ..ops.encoding import LEAF_CONST, TreeBatch, tree_structure_arrays
 from ..ops.eval import eval_single_tree
-from ..ops.fused_eval import fused_loss
+from ..ops.fused_eval import fused_loss, fused_loss_and_const_grad
 
 __all__ = ["OptimizerConfig", "optimize_constants_batch", "optimize_constants_fused"]
 
@@ -111,9 +111,10 @@ def optimize_constants_fused(
 ):
     """TPU-shaped BFGS: the line search is batched *across* members and
     candidate step sizes into one fused-kernel launch per BFGS iteration
-    (candidates = trees with different constant vectors), and the gradient
-    is one vmapped `jax.grad` launch. Sequential depth per iteration is 2
-    launches instead of ~300 tiny interpreter steps.
+    (candidates = trees with different constant vectors), and the
+    gradient comes from the fused forward+backward kernel
+    (`fused_loss_and_const_grad`) — no [T, L, n] interpreter buffers ever
+    touch HBM. Sequential depth per iteration is 2 kernel launches.
 
     Semantics match `optimize_constants_batch` (same Armijo backtracking,
     restarts, accept-if-better rule); restarts ride the member axis.
@@ -127,26 +128,13 @@ def optimize_constants_fused(
         y = jnp.take(data.y, batch_idx)
         w = None if data.weights is None else jnp.take(data.weights, batch_idx)
 
-    child, _, _ = tree_structure_arrays(trees)
+    child, _, _ = tree_structure_arrays(trees, need_depth=False)
     slot = jnp.arange(L)
     cmask = (
         (slot[None, :] < trees.length[:, None])
         & (trees.arity == 0)
         & (trees.op == LEAF_CONST)
     )  # [P, L]
-
-    @jax.checkpoint
-    def member_loss(const, i):
-        """jnp (grad-capable) loss of member i with constants `const`
-        (remat: see optimize_constants_batch's f for why)."""
-        pred, valid = eval_single_tree(
-            trees.arity[i], trees.op[i], trees.feat[i], const,
-            trees.length[i], child[i], X, operators,
-        )
-        return aggregate_loss(elementwise_loss, pred, y, valid, w)
-
-    vg = jax.vmap(jax.value_and_grad(lambda c, i: member_loss(c, i)),
-                  in_axes=(0, 0))
 
     # Expand members × restarts: x0 and perturbed starts x0*(1+0.5ε)
     # (src/ConstantOptimization.jl:90-100).
@@ -156,8 +144,23 @@ def optimize_constants_fused(
         axis=1,
     )  # [P, R, L]
     x = starts.reshape(P * R, L)
-    midx = jnp.repeat(jnp.arange(P), R)
     mask_r = jnp.repeat(cmask, R, axis=0)  # [P*R, L]
+
+    rep_r = lambda a: jnp.repeat(a, R, axis=0)
+    trees_r = TreeBatch(
+        arity=rep_r(trees.arity), op=rep_r(trees.op), feat=rep_r(trees.feat),
+        const=rep_r(trees.const), length=jnp.repeat(trees.length, R),
+    )
+    child_r = rep_r(child)
+
+    def vg(consts):  # [P*R, L] -> (loss [P*R], grad [P*R, L])
+        import dataclasses
+        cand = dataclasses.replace(trees_r, const=consts)
+        loss, _, grad = fused_loss_and_const_grad(
+            cand, child_r, X, y, w, operators, elementwise_loss,
+            interpret=interpret,
+        )
+        return loss, jnp.where(mask_r, grad, 0.0)
 
     ts = cfg.shrink ** jnp.arange(cfg.max_linesearch, dtype=x.dtype)  # [C]
     C = cfg.max_linesearch
@@ -177,8 +180,7 @@ def optimize_constants_fused(
     eye = jnp.eye(L, dtype=x.dtype)
     H0 = jnp.broadcast_to(eye, (P * R, L, L))
 
-    fx0, g0 = vg(x, midx)
-    g0 = jnp.where(mask_r & jnp.isfinite(g0), g0, 0.0)
+    fx0, g0 = vg(x)
     calls0 = jnp.ones((P * R,), jnp.float32)
 
     def bfgs_iter(carry, _):
@@ -200,8 +202,7 @@ def optimize_constants_fused(
         t_star = jnp.where(any_ok, ts[first], 0.0)
         s = t_star[:, None] * d
         x_new = x + s
-        f_new, g_new = vg(x_new, midx)
-        g_new = jnp.where(mask_r & jnp.isfinite(g_new), g_new, 0.0)
+        f_new, g_new = vg(x_new)
         x_new = jnp.where(any_ok[:, None], x_new, x)
         f_new = jnp.where(any_ok, f_new, fx)
         g_new = jnp.where(any_ok[:, None], g_new, g)
@@ -272,7 +273,7 @@ def optimize_constants_batch(
     else:
         KC = 0
 
-    child, _, _ = tree_structure_arrays(trees)
+    child, _, _ = tree_structure_arrays(trees, need_depth=False)
     slot = jnp.arange(L)
 
     def member_fn(k, arity, op, feat, const0, length, ch, active, p0):

@@ -457,46 +457,144 @@ def _make_single_leaf(key, ctx: MutationContext, dtype):
     return t
 
 
+def _random_postfix_from_counts(key, n_binary, n_unary, ctx: MutationContext,
+                                dtype):
+    """Uniform random postfix tree with the given operator-arity counts.
+
+    Loop-free construction (the reference grows trees by sequential leaf
+    expansion, src/MutationFunctions.jl:441-471; a sequential loop is
+    poison on TPU, so we sample the tree *shape* directly):
+
+    1. lay out the arity multiset (``n_binary`` 2s, ``n_unary`` 1s,
+       ``n_binary + 1`` 0s) and shuffle it with a masked argsort;
+    2. rotate it into the unique valid postfix order via the cycle lemma
+       (Dvoretzky–Motzkin: steps ``1 - arity`` sum to 1, so exactly one
+       cyclic rotation keeps every prefix sum positive — start right
+       after the last prefix-sum minimum);
+    3. fill operator indices / leaf payloads with vectorized draws.
+
+    This samples uniformly over tree shapes with the given op counts —
+    a (documented) distributional delta from the reference's growth
+    process, which biases toward unbalanced shapes.
+    """
+    L = ctx.max_nodes
+    k_perm, k_ops1, k_ops2, k_leaf = jax.random.split(key, 4)
+    slot = jnp.arange(L, dtype=jnp.int32)
+    m = 2 * n_binary + n_unary + 1        # total nodes (traced scalar)
+    live = slot < m
+
+    vals = jnp.where(
+        slot < n_binary, 2, jnp.where(slot < n_binary + n_unary, 1, 0)
+    ).astype(jnp.int32)
+    prio = jnp.where(live, jax.random.uniform(k_perm, (L,)), 2.0)
+    perm = jnp.argsort(prio)
+    arity = jnp.where(live, vals[perm], 0)
+
+    # cycle-lemma rotation (dead slots get +inf so they never win the min)
+    S = jnp.cumsum(1 - arity)
+    S_masked = jnp.where(live, S, jnp.iinfo(jnp.int32).max)
+    minS = jnp.min(S_masked)
+    t = jnp.max(jnp.where(S_masked == minS, slot, -1))   # last argmin
+    p = jnp.where(t + 1 >= m, 0, t + 1)
+    src = jnp.where(live, (p + slot) % jnp.maximum(m, 1), slot)
+    arity = jnp.where(live, arity[src], 0)
+
+    # operator indices per arity
+    nuna = ctx.nops[0] if len(ctx.nops) >= 1 else 0
+    nbin = ctx.nops[1] if len(ctx.nops) >= 2 else 0
+    op_u = randint_dyn(k_ops1, max(nuna, 1), (L,))
+    op_b = randint_dyn(k_ops2, max(nbin, 1), (L,))
+
+    # leaf payloads (vectorized _sample_leaf semantics)
+    ks = jax.random.split(k_leaf, 4)
+    const_vals = jax.random.normal(ks[1], (L,), dtype=dtype)
+    feat_vals = randint_dyn(ks[2], ctx.nfeatures, (L,))
+    if ctx.n_params > 0:
+        choice = randint_dyn(ks[0], 3, (L,))
+        p_vals = randint_dyn(ks[3], ctx.n_params, (L,))
+        leaf_code = jnp.where(
+            choice == 0, LEAF_CONST, jnp.where(choice == 1, LEAF_VAR, LEAF_PARAM)
+        )
+        leaf_feat = jnp.where(choice == 1, feat_vals,
+                              jnp.where(choice == 2, p_vals, 0))
+        is_const = choice == 0
+    else:
+        is_const = jax.random.bernoulli(ks[0], shape=(L,))
+        leaf_code = jnp.where(is_const, LEAF_CONST, LEAF_VAR)
+        leaf_feat = jnp.where(is_const, 0, feat_vals)
+
+    op = jnp.where(
+        arity == 2, op_b, jnp.where(arity == 1, op_u, leaf_code)
+    ).astype(jnp.int32)
+    feat = jnp.where((arity == 0) & live, leaf_feat, 0).astype(jnp.int32)
+    const = jnp.where(
+        (arity == 0) & live & is_const, const_vals, jnp.zeros((), dtype)
+    )
+    return TreeBatch(arity=arity, op=op, feat=feat, const=const,
+                     length=m.astype(jnp.int32))
+
+
+def _sample_arity_counts(key, budget, ctx: MutationContext):
+    """(n_binary, n_unary) from iid arity draws filling ``budget`` size
+    increments (binary costs 2, unary 1), matching the reference growth
+    loop's weighted arity sampling in aggregate."""
+    L = ctx.max_nodes
+    nuna = ctx.nops[0] if len(ctx.nops) >= 1 else 0
+    nbin = ctx.nops[1] if len(ctx.nops) >= 2 else 0
+    if nbin == 0 and nuna == 0:
+        z = jnp.zeros((), jnp.int32)
+        return z, z
+    pb = nbin / max(nbin + nuna, 1)
+    draw_bin = jax.random.bernoulli(key, pb, (L,))
+    if nuna == 0:
+        draw_bin = jnp.ones_like(draw_bin)
+    if nbin == 0:
+        draw_bin = jnp.zeros_like(draw_bin)
+    cost = jnp.where(draw_bin, 2, 1).astype(jnp.int32)
+    csum = jnp.cumsum(cost)
+    take = csum <= budget
+    n_binary = jnp.sum(take & draw_bin).astype(jnp.int32)
+    n_unary = jnp.sum(take & ~draw_bin).astype(jnp.int32)
+    if nuna > 0:
+        # fill a leftover single size unit with one unary op
+        total = jnp.max(jnp.where(take, csum, 0))
+        n_unary = n_unary + jnp.where(budget - total >= 1, 1, 0)
+    return n_binary, n_unary
+
+
 def gen_random_tree_fixed_size(key, node_count, ctx: MutationContext, dtype,
                                n_steps=None):
-    """Leaf-growth random tree of ~`node_count` nodes
+    """Random tree of ~``node_count`` nodes
     (gen_random_tree_fixed_size, src/MutationFunctions.jl:441-471)."""
-    L = ctx.max_nodes
-    n_steps = n_steps if n_steps is not None else L
-    k0, kloop = jax.random.split(key)
-    tree0 = _make_single_leaf(k0, ctx, dtype)
-
-    def body(i, tree):
-        k = jax.random.fold_in(kloop, i)
-        k1, k2, k3 = jax.random.split(k, 3)
-        remaining = node_count - tree.length
-        limit = jnp.minimum(remaining, MAX_ARITY)
-        a, o, any_op = _sample_new_op(k1, ctx, limit_arity=limit)
-        mask = _slot_mask(tree) & (tree.arity == 0)
-        k_leaf, has_leaf = masked_choice(k2, mask)
-        scratch = _make_leaf_scratch(k3, MAX_ARITY, ctx, dtype)
-        scratch = _write_op_slot(scratch, a, o)
-        new_tree, ok = _expand_leaf_pieces(
-            tree, scratch, k_leaf, k_leaf, jnp.int32(1), a, jnp.int32(-1), ctx
-        )
-        do = (remaining > 0) & any_op & has_leaf & ok
-        return _select_tree(do, new_tree, tree)
-
-    return jax.lax.fori_loop(0, n_steps, body, tree0)
+    del n_steps  # legacy knob of the sequential-growth implementation
+    k1, k2 = jax.random.split(key)
+    budget = jnp.clip(node_count, 1, ctx.max_nodes) - 1
+    n_binary, n_unary = _sample_arity_counts(k1, budget, ctx)
+    return _random_postfix_from_counts(k2, n_binary, n_unary, ctx, dtype)
 
 
 def gen_random_tree(key, nlength, ctx: MutationContext, dtype):
-    """Append `nlength` random ops at random leaves (gen_random_tree,
-    :384-398). Initial placeholder leaf is replaced by the first append."""
-    k0, kloop = jax.random.split(key)
-    tree0 = _make_single_leaf(k0, ctx, dtype)
-
-    def body(i, tree):
-        k = jax.random.fold_in(kloop, i)
-        new_tree, ok = append_random_op(k, tree, ctx)
-        return _select_tree(ok, new_tree, tree)
-
-    return jax.lax.fori_loop(0, nlength, body, tree0)
+    """Random tree from ``nlength`` weighted op draws (gen_random_tree,
+    :384-398 appends `nlength` ops; sizes land in [nlength+1, 2*nlength+1])."""
+    L = ctx.max_nodes
+    k1, k2, k3 = jax.random.split(key, 3)
+    nuna = ctx.nops[0] if len(ctx.nops) >= 1 else 0
+    nbin = ctx.nops[1] if len(ctx.nops) >= 2 else 0
+    if nbin == 0 and nuna == 0:
+        return _make_single_leaf(k1, ctx, dtype)
+    pb = nbin / max(nbin + nuna, 1)
+    draw_bin = jax.random.bernoulli(k1, pb, (L,))
+    if nuna == 0:
+        draw_bin = jnp.ones_like(draw_bin)
+    if nbin == 0:
+        draw_bin = jnp.zeros_like(draw_bin)
+    cost = jnp.where(draw_bin, 2, 1).astype(jnp.int32)
+    n_ops = jnp.minimum(jnp.asarray(nlength, jnp.int32), L)
+    slot = jnp.arange(L, dtype=jnp.int32)
+    take = (slot < n_ops) & (jnp.cumsum(cost) <= L - 1)
+    n_binary = jnp.sum(take & draw_bin).astype(jnp.int32)
+    n_unary = jnp.sum(take & ~draw_bin).astype(jnp.int32)
+    return _random_postfix_from_counts(k3, n_binary, n_unary, ctx, dtype)
 
 
 def randomize_tree(key, tree: TreeBatch, cur_maxsize, ctx: MutationContext):

@@ -250,9 +250,7 @@ def _first_valid(valid, stacked: TreeBatch, fallback: TreeBatch):
 
 def _check_single(tree: TreeBatch, options, tables, cur_maxsize):
     batched = jax.tree.map(lambda x: x[None], tree)
-    child, size, depth = tree_structure_arrays(batched)
-    ok = check_constraints_batch(batched, options, tables, cur_maxsize,
-                                 child, size, depth)
+    ok = check_constraints_batch(batched, options, tables, cur_maxsize)
     return ok[0]
 
 
@@ -399,9 +397,8 @@ def generation_step(
                 structure=struct1,
             )
         )(att_keys)
-        child, size, depth = tree_structure_arrays(att_trees)
         att_cons = check_constraints_batch(
-            att_trees, options, tables, cur_maxsize, child, size, depth
+            att_trees, options, tables, cur_maxsize
         )
         att_valid = att_ok & att_cons
         mut_tree, mut_success = _first_valid(att_valid, att_trees, m1.trees)
@@ -429,10 +426,8 @@ def generation_step(
                 ak, m1.trees, m2.trees, cfg.mctx, struct1, struct2
             )
         )(xa_keys)
-        ch1, sz1, dp1 = tree_structure_arrays(c1s)
-        cons1 = check_constraints_batch(c1s, options, tables, cur_maxsize, ch1, sz1, dp1)
-        ch2, sz2, dp2 = tree_structure_arrays(c2s)
-        cons2 = check_constraints_batch(c2s, options, tables, cur_maxsize, ch2, sz2, dp2)
+        cons1 = check_constraints_batch(c1s, options, tables, cur_maxsize)
+        cons2 = check_constraints_batch(c2s, options, tables, cur_maxsize)
         pair_valid = ok1s & ok2s & cons1 & cons2
         xo1, xo_success = _first_valid(pair_valid, c1s, m1.trees)
         xo2, _ = _first_valid(pair_valid, c2s, m2.trees)

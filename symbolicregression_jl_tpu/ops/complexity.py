@@ -126,15 +126,18 @@ def check_constraints_batch(
     options: Options,
     tables: ComplexityTables,
     cur_maxsize: jax.Array,
-    child: jax.Array,
-    size: jax.Array,
-    depth: jax.Array,
+    child: jax.Array = None,
+    size: jax.Array = None,
+    depth: jax.Array = None,
 ) -> jax.Array:
     """Vectorized check_constraints (src/CheckConstraints.jl:66-96).
 
-    `child/size/depth` come from `tree_structure_arrays`. Returns bool[...]
-    (True = satisfies all constraints).
+    `child/size/depth` may be precomputed by the caller; otherwise they
+    are derived here *only if* the configured constraints need them.
+    Returns bool[...] (True = satisfies all constraints).
     """
+    from .encoding import tree_structure_arrays
+
     L = batch.max_nodes
     batch_shape = batch.batch_shape
     slot = jnp.arange(L)
@@ -143,17 +146,23 @@ def check_constraints_batch(
     complexity = compute_complexity_batch(batch, tables)
     ok = complexity <= cur_maxsize
 
-    root_depth = jnp.max(jnp.where(mask, depth, 0), axis=-1)
-    ok = ok & (root_depth <= options.maxdepth)
-
-    # Per-operator argument-size constraints
-    # (flag_operator_complexity, src/CheckConstraints.jl:14-32).
     has_op_cons = any(
         any(c != -1 for c in cons)
         for d, conslist in options.op_constraints.items()
         for cons in conslist
     )
+
+    if options.maxdepth < L:
+        if depth is None:
+            child, size, depth = tree_structure_arrays(batch, need_depth=True)
+        root_depth = jnp.max(jnp.where(mask, depth, 0), axis=-1)
+        ok = ok & (root_depth <= options.maxdepth)
+
+    # Per-operator argument-size constraints
+    # (flag_operator_complexity, src/CheckConstraints.jl:14-32).
     if has_op_cons or options.nested_constraints:
+        if size is None:
+            child, size, _ = tree_structure_arrays(batch, need_depth=False)
         w = _node_weights(batch, tables)
         flat_w = w.reshape(-1, L)
         flat_size = size.reshape(-1, L)

@@ -81,7 +81,7 @@ def _encode_single(tree: Node, operators: OperatorSet, dtype):
         const=jnp.asarray(const)[None],
         length=jnp.asarray(length)[None],
     )
-    child, _, _ = tree_structure_arrays(batch)
+    child, _, _ = tree_structure_arrays(batch, need_depth=False)
     return (
         batch.arity[0], batch.op[0], batch.feat[0], batch.const[0],
         batch.length[0], child[0],

@@ -281,7 +281,7 @@ def dimensional_violations_batch(
     """``violates[...batch]`` — True where a tree breaks unit constraints."""
     batch_shape = batch.batch_shape
     flat = batch.reshape(-1)
-    child, _, _ = tree_structure_arrays(flat)
+    child, _, _ = tree_structure_arrays(flat, need_depth=False)
     f = jax.vmap(
         lambda a, o, ft, c, ln, ch: _single_tree_violation(
             a, o, ft, c, ln, ch,

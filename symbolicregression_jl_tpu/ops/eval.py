@@ -127,7 +127,7 @@ def eval_tree_batch(
     batch_shape = batch.batch_shape
     L = batch.max_nodes
     flat = batch.reshape(-1)
-    child, _, _ = tree_structure_arrays(flat)
+    child, _, _ = tree_structure_arrays(flat, need_depth=False)
 
     if params is None:
         f = jax.vmap(

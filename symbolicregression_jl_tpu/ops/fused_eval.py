@@ -36,7 +36,8 @@ from jax.experimental.pallas import tpu as pltpu
 from .encoding import LEAF_CONST, LEAF_VAR, TreeBatch
 from .operators import OperatorSet
 
-__all__ = ["fused_loss", "stack_positions", "supports_fused_eval"]
+__all__ = ["fused_loss", "fused_loss_and_const_grad", "stack_positions",
+           "supports_fused_eval"]
 
 
 def stack_positions(arity: jax.Array) -> jax.Array:
@@ -48,6 +49,21 @@ def stack_positions(arity: jax.Array) -> jax.Array:
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+def _pick_tile(n: int, tile_cap: int, vmem_rows: int, bytes_per: int,
+               budget: int = 10 * 2**20) -> int:
+    """Row-tile size: prefer one tile covering all rows (padded to 1024)
+    so the per-slot scalar dispatch overhead is paid once per tree, not
+    once per (tree, tile); fall back to smaller tiles on VMEM pressure.
+
+    ``vmem_rows`` = number of TILE-wide scratch rows the kernel keeps
+    resident (stack/buffer/adjoint for all trees of a block).
+    """
+    tile = min(_round_up(n, 1024), _round_up(tile_cap, 1024))
+    while tile > 1024 and vmem_rows * tile * bytes_per > budget:
+        tile = _round_up(tile // 2, 1024)
+    return tile  # floor is 1024 (every branch rounds up to 1024)
 
 
 def supports_fused_eval(operators: OperatorSet) -> bool:
@@ -191,7 +207,7 @@ def fused_loss(
     loss_fn: Callable,
     *,
     tree_block: int = 8,
-    tile_rows: int = 2048,
+    tile_rows: int = 16384,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Mean elementwise loss per tree, fused on TPU.
@@ -207,14 +223,9 @@ def fused_loss(
     dtype = X.dtype
 
     TB = tree_block
-    TILE = min(tile_rows, _round_up(n, 128))
-    # Keep the stack scratch + row tiles inside the ~16MB VMEM budget.
     S_est = L // 2 + 2
     bytes_per = jnp.dtype(dtype).itemsize
-    while TB * S_est * TILE * bytes_per > 10 * 2**20 and TILE > 512:
-        TILE //= 2
-    while TB * S_est * TILE * bytes_per > 10 * 2**20 and TB > 8:
-        TB //= 2
+    TILE = _pick_tile(n, tile_rows, TB * S_est, bytes_per)
     T_pad = _round_up(T, TB)
     n_pad = _round_up(n, TILE)
 
@@ -283,3 +294,314 @@ def fused_loss(
     if batch_shape:
         return loss.reshape(batch_shape), valid.reshape(batch_shape)
     return loss[0], valid[0]
+
+
+# ---------------------------------------------------------------------------
+# Fused forward + backward: loss and d(loss)/d(const) in one kernel
+# ---------------------------------------------------------------------------
+#
+# This replaces `jax.grad` through the jnp interpreter for constant
+# optimization (the reference's Enzyme/Mooncake reverse pass,
+# /root/reference/src/ConstantOptimization.jl:136-167). The jnp/AD path
+# materializes [T, L, n] forward buffers in HBM per gradient evaluation —
+# the dominant cost (and OOM source) of the whole search iteration. Here
+# the per-tree value buffer and adjoint live in VMEM, derivative code for
+# each operator is generated at trace time with `jax.vjp` on the op's own
+# fn (so custom traceable operators differentiate automatically), and the
+# only HBM traffic is the X/y row tiles plus a [T, L] gradient output.
+
+
+def _vjp_unary(fn, x, ct):
+    _, vjp = jax.vjp(fn, x)
+    (dx,) = vjp(ct)
+    return dx
+
+
+def _vjp_binary(fn, x, y, ct):
+    _, vjp = jax.vjp(fn, x, y)
+    dx, dy = vjp(ct)
+    return dx, dy
+
+
+def _make_grad_kernel(
+    operators: OperatorSet,
+    loss_fn: Callable,
+    max_nodes: int,
+    tree_block: int,
+):
+    unary_fns = tuple(op.fn for op in operators.unary)
+    binary_fns = tuple(op.fn for op in operators.binary)
+    L = max_nodes
+
+    def kernel(
+        arity_ref,   # SMEM [TB, L]
+        op_ref,      # SMEM [TB, L]
+        feat_ref,    # SMEM [TB, L]
+        child1_ref,  # SMEM [TB, L]
+        child2_ref,  # SMEM [TB, L]
+        root_ref,    # SMEM [TB, 1] (length - 1)
+        const_ref,   # SMEM [TB, L] f32
+        cmask_ref,   # VMEM [TB, L] f32: 1.0 at constant-leaf slots
+        x_ref,       # VMEM [F, TILE]
+        y_ref,       # VMEM [1, TILE]
+        w_ref,       # VMEM [1, TILE]
+        mask_ref,    # VMEM [1, TILE]
+        loss_ref,    # SMEM out [TB, 1] f32 (loss sum over rows)
+        valid_ref,   # SMEM out [TB, 1] int32
+        gconst_ref,  # VMEM out [TB, L] f32 (d loss_sum / d const)
+        buf_ref,     # VMEM scratch [L, TILE] — forward values per slot
+        adj_ref,     # VMEM scratch [L, TILE] — adjoints per slot
+    ):
+        j = pl.program_id(1)
+        y_row = y_ref[0, :]
+        mask_row = mask_ref[0, :] > 0
+        w_row = w_ref[0, :] * mask_ref[0, :]
+        tile = y_row.shape[0]
+
+        for t in range(tree_block):
+            root = root_ref[t, 0]
+
+            # ---- forward: slot-indexed buffer interpreter ----
+            def fwd(k, vmask):
+                a = arity_ref[t, k]
+                o = op_ref[t, k]
+
+                def leaf_val():
+                    x_row = x_ref[feat_ref[t, k], :]
+                    c = jnp.full((tile,), const_ref[t, k], dtype=x_ref.dtype)
+                    return jnp.where(o == LEAF_CONST, c, x_row)
+
+                def unary_val():
+                    child = buf_ref[child1_ref[t, k], :]
+                    if len(unary_fns) == 1:
+                        return unary_fns[0](child)
+                    return jax.lax.switch(o, unary_fns, child)
+
+                def binary_val():
+                    l = buf_ref[child1_ref[t, k], :]
+                    r = buf_ref[child2_ref[t, k], :]
+                    if len(binary_fns) == 1:
+                        return binary_fns[0](l, r)
+                    return jax.lax.switch(o, binary_fns, l, r)
+
+                branches = [leaf_val]
+                branches.append(unary_val if unary_fns else leaf_val)
+                branches.append(binary_val if binary_fns else leaf_val)
+                val = jax.lax.switch(a, branches)
+                buf_ref[k, :] = val
+                return vmask * jnp.isfinite(val).astype(vmask.dtype)
+
+            vmask = jax.lax.fori_loop(
+                0, L, fwd, jnp.ones((tile,), y_row.dtype)
+            )
+            valid = jnp.all((vmask > 0) | jnp.logical_not(mask_row))
+
+            # ---- loss + dloss/dpred ----
+            pred = buf_ref[root, :]
+            elt, loss_vjp = jax.vjp(lambda p: loss_fn(p, y_row), pred)
+            elt = jnp.where(w_row > 0, elt, 0.0)
+            partial = jnp.sum(elt * w_row)
+            partial_ok = jnp.int32(valid & jnp.isfinite(partial))
+            (dpred,) = loss_vjp(w_row)
+            dpred = jnp.where(w_row > 0, dpred, 0.0)
+
+            # ---- backward: adjoint sweep root -> leaves ----
+            # Padding slots (arity 0) clip children to slot 0 and carry
+            # zero cotangents, so their accumulates are no-ops; pure value
+            # switches + masked adds avoid side effects under lax.switch.
+            adj_ref[...] = jnp.zeros((L, tile), dtype=y_row.dtype)
+            adj_ref[root, :] = dpred
+
+            def bwd(i, _):
+                k = L - 1 - i
+                a = arity_ref[t, k]
+                o = op_ref[t, k]
+                c1 = child1_ref[t, k]
+                c2 = child2_ref[t, k]
+                ct = adj_ref[k, :]
+                x1 = buf_ref[c1, :]
+                x2 = buf_ref[c2, :]
+
+                zero = jnp.zeros_like(ct)
+                if unary_fns:
+                    if len(unary_fns) == 1:
+                        du = _vjp_unary(unary_fns[0], x1, ct)
+                    else:
+                        du = jax.lax.switch(
+                            o, [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
+                                for f in unary_fns], x1, ct)
+                else:
+                    du = zero
+                if binary_fns:
+                    if len(binary_fns) == 1:
+                        db1, db2 = _vjp_binary(binary_fns[0], x1, x2, ct)
+                    else:
+                        db1, db2 = jax.lax.switch(
+                            o, [lambda xx, yy, cc, f=f: _vjp_binary(f, xx, yy, cc)
+                                for f in binary_fns], x1, x2, ct)
+                else:
+                    db1, db2 = zero, zero
+                dx = jnp.where(a == 1, du, jnp.where(a == 2, db1, zero))
+                dy = jnp.where(a == 2, db2, zero)
+                # Padded rows carry zero cotangents but arbitrary (zero)
+                # operand values, so op vjps can produce 0/0 = NaN there;
+                # mask every step or one NaN poisons the row sums.
+                dx = jnp.where(mask_row, dx, 0.0)
+                dy = jnp.where(mask_row, dy, 0.0)
+                adj_ref[c1, :] = adj_ref[c1, :] + dx
+                adj_ref[c2, :] = adj_ref[c2, :] + dy
+                return 0
+
+            jax.lax.fori_loop(0, L, bwd, 0)
+
+            # ---- per-slot constant gradients (sum over rows) ----
+            grow = jnp.sum(adj_ref[...], axis=1) * cmask_ref[t, :]
+
+            @pl.when(j == 0)
+            def _():
+                gconst_ref[t, :] = grow
+
+            @pl.when(j != 0)
+            def _():
+                gconst_ref[t, :] = gconst_ref[t, :] + grow
+
+            @pl.when(j == 0)
+            def _():
+                loss_ref[t, 0] = partial
+                valid_ref[t, 0] = partial_ok
+
+            @pl.when(j != 0)
+            def _():
+                loss_ref[t, 0] = loss_ref[t, 0] + partial
+                valid_ref[t, 0] = valid_ref[t, 0] & partial_ok
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "operators", "loss_fn", "tree_block", "tile_rows", "interpret",
+    ),
+)
+def fused_loss_and_const_grad(
+    trees: TreeBatch,
+    child: jax.Array,           # [..., L, 2] from tree_structure_arrays
+    X: jax.Array,               # [F, n]
+    y: jax.Array,               # [n]
+    weights: Optional[jax.Array],
+    operators: OperatorSet,
+    loss_fn: Callable,
+    *,
+    tree_block: int = 8,
+    tile_rows: int = 16384,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(loss, valid, dloss/dconst) per tree, in one fused TPU kernel.
+
+    ``loss`` is the mean elementwise loss (invalid => inf, matching
+    `fused_loss`); the gradient is w.r.t. every constant-leaf slot of
+    ``trees.const`` (zero elsewhere, zero for invalid trees).
+    """
+    batch_shape = trees.batch_shape
+    flat = trees.reshape(-1) if batch_shape else trees.reshape(1)
+    ch_flat = child.reshape(-1, child.shape[-2], child.shape[-1])
+    T = flat.length.shape[0]
+    L = flat.arity.shape[-1]
+    F, n = X.shape
+    dtype = X.dtype
+
+    TB = tree_block
+    bytes_per = jnp.dtype(dtype).itemsize
+    # scratch: buf + adj, both [L, TILE]
+    TILE = _pick_tile(n, tile_rows, 2 * L, bytes_per)
+    T_pad = _round_up(T, TB)
+    n_pad = _round_up(n, TILE)
+
+    def pad_trees(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
+                       constant_values=fill)
+
+    arity = pad_trees(flat.arity)
+    op = pad_trees(flat.op)
+    feat = jnp.clip(pad_trees(flat.feat), 0, F - 1)
+    const = pad_trees(flat.const).astype(dtype)
+    child1 = jnp.clip(pad_trees(ch_flat[..., 0]), 0, L - 1)
+    child2 = jnp.clip(pad_trees(ch_flat[..., 1]), 0, L - 1)
+    root = jnp.clip(
+        pad_trees(flat.length.reshape(-1, 1), fill=1) - 1, 0, L - 1
+    )
+    slot = jnp.arange(L)
+    cmask = (
+        (slot[None, :] < flat.length[:, None])
+        & (flat.arity == 0)
+        & (flat.op == LEAF_CONST)
+    ).astype(dtype)
+    cmask = pad_trees(cmask)
+
+    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+    yp = jnp.pad(y.reshape(1, n), ((0, 0), (0, n_pad - n)))
+    w = jnp.ones((1, n), dtype) if weights is None else weights.reshape(1, n).astype(dtype)
+    wp = jnp.pad(w, ((0, 0), (0, n_pad - n)))
+    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
+
+    grid = (T_pad // TB, n_pad // TILE)
+    kernel = _make_grad_kernel(operators, loss_fn, L, TB)
+
+    smem_i32 = lambda shape: pl.BlockSpec(
+        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
+    )
+    row_spec = pl.BlockSpec((1, TILE), lambda i, j: (0, j))
+
+    loss_sum, valid, gconst = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            smem_i32((TB, L)),                       # arity
+            smem_i32((TB, L)),                       # op
+            smem_i32((TB, L)),                       # feat
+            smem_i32((TB, L)),                       # child1
+            smem_i32((TB, L)),                       # child2
+            smem_i32((TB, 1)),                       # root
+            pl.BlockSpec((TB, L), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),   # const
+            pl.BlockSpec((TB, L), lambda i, j: (i, 0)),    # cmask
+            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),  # X
+            row_spec,                                # y
+            row_spec,                                # w
+            row_spec,                                # mask
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((TB, L), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_pad, 1), dtype),
+            jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((T_pad, L), dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((L, TILE), dtype),
+            pltpu.VMEM((L, TILE), dtype),
+        ],
+        interpret=interpret,
+    )(arity, op, feat, child1, child2, root, const, cmask, Xp, yp, wp, maskp)
+
+    loss_sum = loss_sum[:T, 0]
+    valid = valid[:T, 0].astype(jnp.bool_)
+    gconst = gconst[:T]
+    denom = jnp.sum(w) if weights is not None else jnp.asarray(n, dtype)
+    loss = loss_sum / denom
+    grad = gconst / denom
+    bad = ~(valid & jnp.isfinite(loss))
+    loss = jnp.where(bad, jnp.inf, loss)
+    grad = jnp.where(
+        bad[:, None] | ~jnp.isfinite(grad), 0.0, grad
+    )
+    if batch_shape:
+        return (loss.reshape(batch_shape), valid.reshape(batch_shape),
+                grad.reshape(*batch_shape, L))
+    return loss[0], valid[0], grad[0]

@@ -18,9 +18,10 @@ if "xla_cpu_enable_fast_math" not in flags:
 os.environ["XLA_FLAGS"] = flags.strip()
 os.environ.setdefault("SYMBOLIC_REGRESSION_IS_TESTING", "true")
 
-# The env var alone is not enough: this machine's sitecustomize pre-imports
-# jax with JAX_PLATFORMS=axon (TPU), latching the platform before conftest
-# runs. jax.config.update re-pins it after the fact.
+# The env var alone is not enough: this machine's sitecustomize registers
+# an accelerator PJRT plugin and force-sets jax_platforms at interpreter
+# start (before conftest runs), silently routing "CPU" tests to a remote
+# chip and defeating the virtual 8-device mesh. Re-pin it after the fact.
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

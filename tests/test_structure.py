@@ -1,0 +1,97 @@
+"""Closed-form tree_structure_arrays vs a reference host implementation."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from symbolicregression_jl_tpu.evolve.mutation import (
+    MutationContext,
+    gen_random_tree_fixed_size,
+)
+from symbolicregression_jl_tpu.ops.encoding import (
+    MAX_ARITY,
+    tree_structure_arrays,
+)
+
+
+def host_structure(arity, length):
+    """Straightforward stack walk on host (the pre-rewrite semantics)."""
+    L = len(arity)
+    child = np.zeros((L, MAX_ARITY), np.int32)
+    size = np.ones(L, np.int32)
+    depth = np.ones(L, np.int32)
+    stack = []
+    for k in range(L):
+        a = int(arity[k])
+        kids = stack[len(stack) - a:] if a else []
+        del stack[len(stack) - a:]
+        for j, c in enumerate(kids):
+            child[k, j] = c
+            size[k] += size[c]
+            depth[k] = max(depth[k], depth[c] + 1)
+        stack.append(k)
+    return child, size, depth
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_structure_matches_host_walk(seed):
+    ctx = MutationContext(
+        nops=(3, 4), nfeatures=5, max_nodes=31,
+        perturbation_factor=0.076, probability_negate_constant=0.01,
+    )
+    key = jax.random.PRNGKey(seed)
+    sizes = jax.random.randint(jax.random.fold_in(key, 1), (16,), 1, 31)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(k, s, ctx, jnp.float32)
+    )(jax.random.split(key, 16), sizes)
+
+    child, size, depth = jax.tree.map(np.asarray, tree_structure_arrays(trees))
+    arity = np.asarray(trees.arity)
+    length = np.asarray(trees.length)
+
+    for i in range(16):
+        Lh = int(length[i])
+        # generated trees are valid postfix: stack heights check out
+        D = np.cumsum(1 - arity[i][:Lh])
+        assert (D >= 1).all() and D[-1] == 1, f"invalid postfix {arity[i][:Lh]}"
+        ch, sz, dp = host_structure(arity[i], Lh)
+        np.testing.assert_array_equal(size[i][:Lh], sz[:Lh])
+        np.testing.assert_array_equal(depth[i][:Lh], dp[:Lh])
+        np.testing.assert_array_equal(child[i][:Lh], ch[:Lh])
+
+
+def test_gen_random_tree_fixed_size_hits_target():
+    ctx = MutationContext(
+        nops=(2, 4), nfeatures=3, max_nodes=25,
+        perturbation_factor=0.076, probability_negate_constant=0.01,
+    )
+    for seed in range(6):
+        for target in (1, 2, 5, 12, 25):
+            t = gen_random_tree_fixed_size(
+                jax.random.PRNGKey(seed * 100 + target), target, ctx,
+                jnp.float32)
+            m = int(t.length)
+            assert 1 <= m <= target
+            a = np.asarray(t.arity)
+            assert (a[m:] == 0).all()
+            D = np.cumsum(1 - a[:m])
+            assert (D >= 1).all() and D[-1] == 1
+
+
+def test_gen_random_tree_unary_only_and_binary_only():
+    for nops, tgt in (((3, 0), 9), ((0, 2), 9)):
+        ctx = MutationContext(
+            nops=nops, nfeatures=2, max_nodes=15,
+            perturbation_factor=0.076, probability_negate_constant=0.01,
+        )
+        t = gen_random_tree_fixed_size(jax.random.PRNGKey(0), tgt, ctx,
+                                       jnp.float32)
+        m = int(t.length)
+        a = np.asarray(t.arity)[:m]
+        D = np.cumsum(1 - a)
+        assert (D >= 1).all() and D[-1] == 1
+        if nops[1] == 0:
+            assert (a != 2).all()
+        if nops[0] == 0:
+            assert (a != 1).all()
