@@ -1,0 +1,63 @@
+"""Shared setup for the profiling scripts: the bench problem + timers.
+
+Keeps every profile anchored to the same workload as bench.py (10k rows,
+5 features, ops {+,-,*,/,exp,abs,cos}, maxsize 30).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+N_ROWS = 10_000
+N_FEATURES = 5
+
+
+def make_bench_problem(n_rows: int = N_ROWS, nfeatures: int = N_FEATURES,
+                       **options_kw):
+    """(options, dataset, engine) on the bench workload."""
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+
+    kw = dict(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["exp", "abs", "cos"],
+        maxsize=30,
+        save_to_file=False,
+    )
+    kw.update(options_kw)
+    options = Options(**kw)
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-3.0, 3.0, (n_rows, nfeatures)).astype(np.float32)
+    y = (
+        np.cos(2.13 * X[:, 0])
+        + 0.5 * X[:, 1] * np.abs(X[:, 2]) ** 0.9
+        - 0.3 * np.abs(X[:, 3]) ** 1.5
+    ).astype(np.float32)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+    engine = Engine(options, ds.nfeatures)
+    return options, ds, engine
+
+
+def timeit(fn, *args, n=10, warmup=2):
+    """Queue n calls, block once — amortizes the tunnel round trip.
+
+    Only valid for measuring launch *throughput*; per-call latency on the
+    tunneled TPU is meaningless (see .claude/skills/verify gotchas).
+    """
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n

@@ -1,0 +1,113 @@
+"""Measured CPU baseline: multithreaded full-dataset tree evaluations/sec.
+
+Stands in for the reference's CPU-multithreaded evaluation rate on the
+bench problem (10k rows, 5 features, ops {+,-,*,/,exp,abs,cos},
+maxsize=30). The reference's hot loop evaluates one expression over the
+whole dataset per mutation attempt with a fused SIMD interpreter
+(LoopVectorization `turbo`); the closest honest Python-host equivalent is
+a recursive numpy evaluator with one vectorized op per node, parallelized
+across expressions with a thread pool (numpy releases the GIL).
+
+Prints a JSON line: {"cpu_evals_per_sec": N, "threads": T, "n_trees": K}.
+BASELINE.md records the measured number; bench.py's vs_baseline uses it.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.evolve.mutation import (
+        MutationContext,
+        gen_random_tree_fixed_size,
+    )
+    from symbolicregression_jl_tpu.ops.encoding import decode_population
+
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["exp", "abs", "cos"],
+        maxsize=30,
+        save_to_file=False,
+    )
+    rng = np.random.default_rng(0)
+    n_rows = 10_000
+    X = rng.uniform(-3.0, 3.0, (n_rows, 5)).astype(np.float32)
+    y = np.cos(2.13 * X[:, 0]).astype(np.float32)
+    cols = [np.ascontiguousarray(X[:, j]) for j in range(X.shape[1])]
+
+    # population of random trees matching the search's size distribution
+    ctx = MutationContext(
+        nops=(3, 4), nfeatures=5, max_nodes=30,
+        perturbation_factor=0.076, probability_negate_constant=0.01,
+    )
+    import jax.numpy as jnp
+    import jax as _jax
+
+    K = 512
+    sizes = _jax.random.randint(_jax.random.PRNGKey(1), (K,), 3, 30)
+    batch = _jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(k, s, ctx, jnp.float32)
+    )(_jax.random.split(_jax.random.PRNGKey(0), K), sizes)
+    trees = decode_population(batch, options.operators)
+
+    UN = {
+        "exp": np.exp, "abs": np.abs, "cos": np.cos,
+    }
+    BIN = {
+        "+": np.add, "-": np.subtract, "*": np.multiply, "/": np.divide,
+    }
+
+    def eval_node(node):
+        if node.degree == 0:
+            if node.constant:
+                return np.full(n_rows, node.val, np.float32)
+            return cols[node.feature]
+        if node.degree == 1:
+            return UN[node.op.name](eval_node(node.children[0]))
+        return BIN[node.op.name](
+            eval_node(node.children[0]), eval_node(node.children[1])
+        )
+
+    def eval_loss(tree):
+        with np.errstate(all="ignore"):
+            pred = eval_node(tree)
+            d = pred - y
+            return float(np.mean(d * d))
+
+    threads = os.cpu_count() or 1
+
+    # warmup
+    for t in trees[:8]:
+        eval_loss(t)
+
+    REPEAT = 4
+    t0 = time.perf_counter()
+    with cf.ThreadPoolExecutor(max_workers=threads) as ex:
+        for _ in range(REPEAT):
+            list(ex.map(eval_loss, trees))
+    dt = time.perf_counter() - t0
+    rate = REPEAT * len(trees) / dt
+    print(json.dumps({
+        "cpu_evals_per_sec": round(rate, 1),
+        "threads": threads,
+        "n_trees": len(trees),
+        "n_rows": n_rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Measure run_iteration throughput (evals/s) across search configs."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+from _common import make_bench_problem
+
+
+def main():
+    configs = [
+        dict(populations=15, population_size=33),
+        dict(populations=40, population_size=33),
+        dict(populations=64, population_size=128, tournament_selection_n=8),
+        dict(populations=128, population_size=128, tournament_selection_n=8),
+    ]
+    for cfg_kw in configs:
+        options, ds, engine = make_bench_problem(
+            ncycles_per_iteration=100, **cfg_kw
+        )
+        try:
+            from symbolicregression_jl_tpu import search_key
+
+            state = engine.init_state(search_key(0), ds.data,
+                                      options.populations)
+            state = engine.run_iteration(state, ds.data, options.maxsize)
+            jax.block_until_ready(state.pops.cost)
+            ev0 = float(state.num_evals)
+            t0 = time.perf_counter()
+            N = 3
+            for _ in range(N):
+                state = engine.run_iteration(state, ds.data, options.maxsize)
+            jax.block_until_ready(state.pops.cost)
+            dt = time.perf_counter() - t0
+            ev = float(state.num_evals) - ev0
+            print(f"{cfg_kw}: {ev/dt:10.0f} evals/s   "
+                  f"({dt/N*1e3:.0f} ms/iter, {ev/N:.0f} evals/iter)")
+        except Exception as e:
+            print(f"{cfg_kw}: FAIL {type(e).__name__}: {str(e)[:120]}")
+
+
+if __name__ == "__main__":
+    main()

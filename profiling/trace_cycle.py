@@ -17,33 +17,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from _common import make_bench_problem
+
 
 def main():
     I = int(sys.argv[1]) if len(sys.argv) > 1 else 40
     NC = int(sys.argv[2]) if len(sys.argv) > 2 else 10
     P = int(sys.argv[3]) if len(sys.argv) > 3 else 128
 
-    from symbolicregression_jl_tpu import Options
-    from symbolicregression_jl_tpu.core.dataset import make_dataset
-    from symbolicregression_jl_tpu.evolve.engine import Engine
+    from symbolicregression_jl_tpu import search_key
 
-    options = Options(
-        binary_operators=["+", "-", "*", "/"],
-        unary_operators=["exp", "abs", "cos"],
-        maxsize=30,
-        populations=I,
-        population_size=P,
-        ncycles_per_iteration=NC,
-        save_to_file=False,
+    options, ds, engine = make_bench_problem(
+        populations=I, population_size=P, ncycles_per_iteration=NC,
     )
-    rng = np.random.default_rng(0)
-    X = rng.uniform(-3.0, 3.0, (10_000, 5)).astype(np.float32)
-    y = np.cos(2.13 * X[:, 0]).astype(np.float32)
-    ds = make_dataset(X, y)
-    ds.update_baseline_loss(options.elementwise_loss)
-    engine = Engine(options, ds.nfeatures)
 
-    state = engine.init_state(jax.random.PRNGKey(0), ds.data, I)
+    state = engine.init_state(search_key(0), ds.data, I)
     state = engine.run_iteration(state, ds.data, options.maxsize)  # compile
     jax.block_until_ready(state.pops.cost)
 

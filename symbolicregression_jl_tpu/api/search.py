@@ -317,7 +317,9 @@ def equation_search(
         n_data_shards=ropt.n_data_shards,
     )
 
-    key = jax.random.PRNGKey(
+    from .. import search_key
+
+    key = search_key(
         ropt.seed if ropt.seed is not None else np.random.randint(0, 2**31 - 1)
     )
 

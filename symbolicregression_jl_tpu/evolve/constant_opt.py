@@ -14,6 +14,7 @@ path serves all arities.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple, Optional
 
 import jax
@@ -154,7 +155,6 @@ def optimize_constants_fused(
     child_r = rep_r(child)
 
     def vg(consts):  # [P*R, L] -> (loss [P*R], grad [P*R, L])
-        import dataclasses
         cand = dataclasses.replace(trees_r, const=consts)
         loss, _, grad = fused_loss_and_const_grad(
             cand, child_r, X, y, w, operators, elementwise_loss,
@@ -165,29 +165,61 @@ def optimize_constants_fused(
     ts = cfg.shrink ** jnp.arange(cfg.max_linesearch, dtype=x.dtype)  # [C]
     C = cfg.max_linesearch
 
+    # Hoist the [P*R*C] tree-field replication out of the BFGS scan: only
+    # the constant vectors change between line-search launches, and these
+    # repeats were costing more than the eval kernel itself.
+    rep_rc = lambda a: jnp.repeat(a, R * C, axis=0)
+    trees_rc = TreeBatch(
+        arity=rep_rc(trees.arity), op=rep_rc(trees.op),
+        feat=rep_rc(trees.feat), const=rep_rc(trees.const),
+        length=jnp.repeat(trees.length, R * C),
+    )
+
     def fused_many(consts):  # [P*R*C, L] -> loss [P*R*C]
-        cand = TreeBatch(
-            arity=jnp.repeat(trees.arity, R * C, axis=0)[: consts.shape[0]],
-            op=jnp.repeat(trees.op, R * C, axis=0)[: consts.shape[0]],
-            feat=jnp.repeat(trees.feat, R * C, axis=0)[: consts.shape[0]],
-            const=consts,
-            length=jnp.repeat(trees.length, R * C)[: consts.shape[0]],
-        )
+        cand = dataclasses.replace(trees_rc, const=consts)
         loss, _ = fused_loss(cand, X, y, w, operators, elementwise_loss,
                              interpret=interpret)
         return loss
 
-    eye = jnp.eye(L, dtype=x.dtype)
-    H0 = jnp.broadcast_to(eye, (P * R, L, L))
-
     fx0, g0 = vg(x)
     calls0 = jnp.ones((P * R,), jnp.float32)
 
+    # L-BFGS two-loop recursion instead of dense-H BFGS: the [m, L, L]
+    # Hessian-approximation updates dominated optimizer time on TPU (tiny
+    # per-member matrices hit pathological layouts); the recursion is a
+    # few dozen vector ops on [m, L]. History covers every iteration of
+    # our fixed budget, so search directions match full BFGS in exact
+    # arithmetic.
+    M = P * R
+    hlen = min(int(cfg.iterations), 8)
+    S0 = jnp.zeros((hlen, M, L), x.dtype)
+    Y0 = jnp.zeros((hlen, M, L), x.dtype)
+    rho0 = jnp.zeros((hlen, M), x.dtype)
+
+    def lbfgs_direction(g, S, Y, rho):
+        # newest (s, y, rho) at index 0; empty history slots have rho == 0
+        # and drop out of the recursion as exact no-ops.
+        q = g
+        alphas = []
+        for i in range(hlen):
+            alpha = rho[i] * jnp.sum(S[i] * q, axis=1)       # [M]
+            q = q - alpha[:, None] * Y[i]
+            alphas.append(alpha)
+        yy = jnp.sum(Y[0] * Y[0], axis=1)
+        sy = jnp.sum(S[0] * Y[0], axis=1)
+        gamma = jnp.where((rho[0] != 0) & (yy > 0),
+                          sy / jnp.maximum(yy, 1e-30), 1.0)
+        q = q * jnp.clip(gamma, 1e-8, 1e8)[:, None]
+        for i in reversed(range(hlen)):
+            beta = rho[i] * jnp.sum(Y[i] * q, axis=1)
+            q = q + (alphas[i] - beta)[:, None] * S[i]
+        return -q
+
     def bfgs_iter(carry, _):
-        x, fx, g, H, calls = carry
-        d = -jnp.einsum("mij,mj->mi", H, g)
+        x, fx, g, S, Y, rho, calls = carry
+        d = lbfgs_direction(g, S, Y, rho)
         dg = jnp.sum(d * g, axis=1)
-        use_sd = dg >= 0
+        use_sd = (dg >= 0) | ~jnp.all(jnp.isfinite(d), axis=1)
         d = jnp.where(use_sd[:, None], -g, d)
         dg = jnp.where(use_sd, -jnp.sum(g * g, axis=1), dg)
 
@@ -208,17 +240,16 @@ def optimize_constants_fused(
         g_new = jnp.where(any_ok[:, None], g_new, g)
         yv = g_new - g
         sy = jnp.sum(s * yv, axis=1)
-        rho = jnp.where(jnp.abs(sy) > 1e-10, 1.0 / sy, 0.0)
-        I_rs = eye[None] - rho[:, None, None] * s[:, :, None] * yv[:, None, :]
-        H_new = jnp.einsum("mij,mjk,mlk->mil", I_rs, H, I_rs) + (
-            rho[:, None, None] * s[:, :, None] * s[:, None, :]
-        )
-        h_ok = jnp.all(jnp.isfinite(H_new), axis=(1, 2)) & (rho != 0)
-        H = jnp.where(h_ok[:, None, None], H_new, H)
-        return (x_new, f_new, g_new, H, calls + C + 1), None
+        rho_new = jnp.where(jnp.abs(sy) > 1e-10, 1.0 / sy, 0.0)
+        # push the new (s, y, rho) pair; drop the oldest
+        S = jnp.concatenate([s[None], S[:-1]], axis=0)
+        Y = jnp.concatenate([yv[None], Y[:-1]], axis=0)
+        rho = jnp.concatenate([rho_new[None], rho[:-1]], axis=0)
+        return (x_new, f_new, g_new, S, Y, rho, calls + C + 1), None
 
-    (x, fx, g, _, calls), _ = jax.lax.scan(
-        bfgs_iter, (x, fx0, g0, H0, calls0), None, length=cfg.iterations
+    (x, fx, g, _, _, _, calls), _ = jax.lax.scan(
+        bfgs_iter, (x, fx0, g0, S0, Y0, rho0, calls0), None,
+        length=cfg.iterations,
     )
 
     # best over restarts, accept iff better than the original loss;

@@ -228,17 +228,30 @@ class Engine:
                 birth, ref, cfg, options, tables, el_loss, batch_idx=batch_idx,
             )
 
-        pops, best_seen, nev, birth, ref = jax.vmap(island_cycle)(
+        pops, best_seen, nev, birth, ref, marks = jax.vmap(island_cycle)(
             cycle_keys, state.pops, state.birth, state.ref
         )
+        simp_mark, opt_mark = marks  # [I, P] bools
         num_evals = state.num_evals + jnp.sum(nev) * eval_fraction
 
         # ---- optimize & simplify (src/SingleIteration.jl:68-96) ----
+        # `simplify`-kind mutations are deferred to here (see
+        # generation_step): with should_simplify the whole population is
+        # folded anyway; otherwise fold just the marked members.
         if cfg.should_simplify:
             folded = jax.vmap(
                 lambda t: fold_constants_batch(t, self.nfeatures, cfg.operators)
             )(pops.trees)
             pops = dataclasses.replace(pops, trees=folded)
+        elif float(options.mutation_weights.simplify) > 0:
+            folded = jax.vmap(
+                lambda t: fold_constants_batch(t, self.nfeatures, cfg.operators)
+            )(pops.trees)
+            from .mutation import _select_tree
+
+            pops = dataclasses.replace(
+                pops, trees=_select_tree(simp_mark, folded, pops.trees)
+            )
 
         # A fixed-size random subset per island keeps the grad-BFGS vmap's
         # rematerialized buffers bounded instead of scaling with P. Each
@@ -248,11 +261,37 @@ class Engine:
         # even when that product is < 0.5.
         k_sel = max(1, round(P * options.optimizer_probability))
         gate_p = min(P * options.optimizer_probability / k_sel, 1.0)
-        if options.should_optimize_constants and options.optimizer_probability > 0:
+        opt_kind_on = float(options.mutation_weights.optimize) > 0
+        if opt_kind_on:
+            # Size the selection to cover the expected number of members
+            # marked by `optimize`-kind mutations this iteration (the
+            # reference runs its optimize branch unconditionally per
+            # draw, src/Mutate.jl:571-658) — marks beyond k_sel slots
+            # would otherwise be dropped.
+            wvec = options.mutation_weights.as_vector()
+            frac_opt = float(options.mutation_weights.optimize) / max(
+                float(wvec.sum()), 1e-12
+            )
+            import math
+
+            expected = cfg.n_slots * cfg.ncycles * frac_opt
+            k_sel = max(k_sel, min(P, math.ceil(expected)))
+        if options.should_optimize_constants and (
+            options.optimizer_probability > 0 or opt_kind_on
+        ):
             ko1, ko2, ko3 = jax.random.split(k_opt, 3)
             scores = jax.random.uniform(ko1, (I, P))
+            if opt_kind_on:
+                # `optimize`-kind mutations (deferred from the cycle; see
+                # generation_step) claim selection slots first and bypass
+                # the probability gate (src/Mutate.jl's optimize branch
+                # runs unconditionally on the member).
+                scores = scores + 10.0 * opt_mark.astype(scores.dtype)
             _, sel_idx = jax.lax.top_k(scores, k_sel)  # [I, k_sel]
             gate = jax.random.bernoulli(ko3, gate_p, (I, k_sel))
+            if opt_kind_on:
+                sel_marked = jnp.take_along_axis(opt_mark, sel_idx, axis=1)
+                gate = gate | sel_marked
 
             if cfg.turbo:
                 # One flattened launch across all islands: the fused BFGS

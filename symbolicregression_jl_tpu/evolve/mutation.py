@@ -8,11 +8,18 @@ attempts. Structural edits use the piece-concatenation gathers from
 ``(tree, ok)`` where ``ok=False`` marks a structurally impossible attempt
 (e.g. result would exceed the slot budget), which the generation step
 treats like a failed constraint check.
+
+Randomness: mutation kernels take a flat uniform(0,1) slice ``u`` of a
+statically-known budget (see :func:`branch_nu`) instead of a PRNG key —
+the caller draws ONE bulk uniform tensor per generation step and hands
+out slices, replacing ~1000 per-cycle small RNG device ops with one
+(see evolve/rng.py). Key-based wrappers remain for the random tree
+generators used at init time.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,10 +33,18 @@ from ..ops.encoding import (
     _tree_structure_single,
 )
 from .pieces import combine_sources, concat_pieces, splice_span
-from .rng import masked_choice, randint_dyn
+from .rng import (
+    USlice,
+    u_bernoulli,
+    u_categorical_weights,
+    u_masked_choice,
+    u_normal,
+    u_randint,
+)
 
 __all__ = [
     "MutationContext",
+    "branch_nu",
     "mutate_constant",
     "mutate_operator",
     "mutate_feature",
@@ -56,6 +71,40 @@ class MutationContext(NamedTuple):
     n_params: int = 0      # static; >0 => parametric leaf sampling
 
 
+_SCRATCH_NU = 4 * MAX_ARITY  # uniforms consumed by _make_leaf_scratch
+
+
+def branch_nu(ctx: MutationContext) -> Dict[str, int]:
+    """Uniform-slice budget of each mutation branch (static)."""
+    L = ctx.max_nodes
+    D = len(ctx.nops)
+    S = _SCRATCH_NU
+    return {
+        "mutate_constant": L + 3,
+        "mutate_operator": L + D,
+        "mutate_feature": L + 1,
+        "swap_operands": L,
+        "rotate_tree": L + MAX_ARITY + 1,
+        "add_node": 1 + (L + 2 * D + S) + (2 * D + 1 + S),
+        "insert_node": L + 2 * D + 1 + S,
+        "delete_node": L + 1,
+        "randomize": 1 + 8 * L,
+    }
+
+
+def gen_tree_nu(ctx: MutationContext) -> int:
+    """Uniform budget of gen_random_tree / gen_random_tree_fixed_size."""
+    return 8 * ctx.max_nodes
+
+
+def _assert_consumed(s: "USlice", u, what: str) -> None:
+    """Trace-time check that a kernel consumed exactly its uniform budget
+    (branch_nu drift would otherwise silently mis-slice the stream)."""
+    assert s.i == u.shape[0], (
+        f"{what} consumed {s.i} uniforms, budget is {u.shape[0]}"
+    )
+
+
 def _slot_mask(tree: TreeBatch):
     return jnp.arange(tree.arity.shape[0]) < tree.length
 
@@ -78,7 +127,7 @@ def _span(size, k):
 # ---------------------------------------------------------------------------
 
 
-def _mutate_factor(key, temperature, ctx: MutationContext, dtype):
+def _mutate_factor(u3, temperature, ctx: MutationContext, dtype):
     """Constant perturbation factor (src/MutationFunctions.jl:150-162).
 
     Note: the reference negates when ``rand() > probability_negate_constant``
@@ -86,65 +135,66 @@ def _mutate_factor(key, temperature, ctx: MutationContext, dtype):
     the parameter's docstring and its name. We implement the documented
     semantics (negate *with* probability `probability_negate_constant`).
     """
-    k1, k2, k3 = jax.random.split(key, 3)
     bottom = 0.1
     max_change = ctx.perturbation_factor * temperature + 1.0 + bottom
-    factor = jnp.asarray(max_change, dtype) ** jax.random.uniform(k1, dtype=dtype)
-    bigger = jax.random.bernoulli(k2)
+    factor = jnp.asarray(max_change, dtype) ** u3[0].astype(dtype)
+    bigger = u_bernoulli(u3[1])
     factor = jnp.where(bigger, factor, 1.0 / factor)
-    negate = jax.random.bernoulli(k3, ctx.probability_negate_constant)
+    negate = u_bernoulli(u3[2], ctx.probability_negate_constant)
     return jnp.where(negate, -factor, factor)
 
 
-def mutate_constant(key, tree: TreeBatch, temperature, ctx: MutationContext):
-    k1, k2 = jax.random.split(key)
+def mutate_constant(u, tree: TreeBatch, temperature, ctx: MutationContext):
+    s = USlice(u)
     mask = _slot_mask(tree) & (tree.arity == 0) & (tree.op == LEAF_CONST)
-    idx, has_any = masked_choice(k1, mask)
-    factor = _mutate_factor(k2, temperature, ctx, tree.const.dtype)
+    idx, has_any = u_masked_choice(s.take(ctx.max_nodes), mask)
+    factor = _mutate_factor(s.take(3), temperature, ctx, tree.const.dtype)
+    _assert_consumed(s, u, "mutate_constant")
     new_const = tree.const.at[idx].multiply(factor)
     const = jnp.where(has_any, new_const, tree.const)
     return TreeBatch(tree.arity, tree.op, tree.feat, const, tree.length), jnp.bool_(True)
 
 
-def mutate_parameter_row(key, params, temperature, ctx: MutationContext):
+def mutate_parameter_row(u, params, temperature, ctx: MutationContext):
     """Scale one whole parameter row (all classes) by a mutate factor
     (parametric mutate_constant branch,
     /root/reference/src/ParametricExpression.jl:173-191).
 
-    ``params``: [n_params, n_classes]. No-op when there are no parameters.
+    ``params``: [n_params, n_classes]; ``u``: [4] uniforms. No-op when
+    there are no parameters.
     """
     if params.shape[-2] == 0:
         return params
-    k1, k2 = jax.random.split(key)
-    row = randint_dyn(k1, params.shape[-2])
-    factor = _mutate_factor(k2, temperature, ctx, params.dtype)
+    s = USlice(u)
+    row = u_randint(s.take1(), params.shape[-2])
+    factor = _mutate_factor(s.take(3), temperature, ctx, params.dtype)
     return params.at[row, :].multiply(factor)
 
 
-def mutate_operator(key, tree: TreeBatch, ctx: MutationContext):
-    k1, k2 = jax.random.split(key)
+def mutate_operator(u, tree: TreeBatch, ctx: MutationContext):
+    s = USlice(u)
     mask = _slot_mask(tree) & (tree.arity > 0)
-    idx, has_any = masked_choice(k1, mask)
-    samples = [
-        randint_dyn(jax.random.fold_in(k2, d), max(n, 1))
-        for d, n in enumerate(ctx.nops, start=1)
-    ]
+    idx, has_any = u_masked_choice(s.take(ctx.max_nodes), mask)
+    u_ops = s.take(len(ctx.nops))
+    _assert_consumed(s, u, "mutate_operator")
     a = tree.arity[idx]
     new_op = jnp.int32(0)
-    for d, s in enumerate(samples, start=1):
-        new_op = jnp.where(a == d, s, new_op)
+    for d, n in enumerate(ctx.nops, start=1):
+        new_op = jnp.where(a == d, u_randint(u_ops[d - 1], max(n, 1)), new_op)
     op = jnp.where(has_any, tree.op.at[idx].set(new_op), tree.op)
     return TreeBatch(tree.arity, op, tree.feat, tree.const, tree.length), jnp.bool_(True)
 
 
-def mutate_feature(key, tree: TreeBatch, ctx: MutationContext):
-    k1, k2 = jax.random.split(key)
+def mutate_feature(u, tree: TreeBatch, ctx: MutationContext):
+    s = USlice(u)
     mask = _slot_mask(tree) & (tree.arity == 0) & (tree.op == LEAF_VAR)
-    idx, has_any = masked_choice(k1, mask)
+    idx, has_any = u_masked_choice(s.take(ctx.max_nodes), mask)
+    u_delta = s.take1()
+    _assert_consumed(s, u, "mutate_feature")
     if ctx.nfeatures <= 1:
         return tree, jnp.bool_(True)
     # uniform among features != current (src/MutationFunctions.jl:181)
-    delta = randint_dyn(k2, ctx.nfeatures - 1) + 1
+    delta = u_randint(u_delta, ctx.nfeatures - 1) + 1
     new_feat = (tree.feat[idx] + delta) % ctx.nfeatures
     feat = jnp.where(has_any, tree.feat.at[idx].set(new_feat), tree.feat)
     return TreeBatch(tree.arity, tree.op, feat, tree.const, tree.length), jnp.bool_(True)
@@ -155,12 +205,12 @@ def mutate_feature(key, tree: TreeBatch, ctx: MutationContext):
 # ---------------------------------------------------------------------------
 
 
-def swap_operands(key, tree: TreeBatch, ctx: MutationContext, structure=None):
+def swap_operands(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     """Swap the two child spans of a random binary node (:83-96)."""
     L = ctx.max_nodes
     child, size, _ = _structure(tree, structure)
     mask = _slot_mask(tree) & (tree.arity == 2)
-    k_node, has_any = masked_choice(key, mask)
+    k_node, has_any = u_masked_choice(u, mask)
     c1 = child[k_node, 0]
     c2 = child[k_node, 1]
     s1, l1 = _span(size, c1)
@@ -172,14 +222,15 @@ def swap_operands(key, tree: TreeBatch, ctx: MutationContext, structure=None):
     return _select_tree(has_any, new_tree, tree), ok | ~has_any
 
 
-def delete_node(key, tree: TreeBatch, ctx: MutationContext, structure=None):
+def delete_node(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     """Splice out a random operator node, keeping one child (:336-356)."""
     L = ctx.max_nodes
-    k1, k2 = jax.random.split(key)
+    s = USlice(u)
     child, size, _ = _structure(tree, structure)
     mask = _slot_mask(tree) & (tree.arity > 0)
-    k_node, has_any = masked_choice(k1, mask)
-    carry_i = randint_dyn(k2, jnp.maximum(tree.arity[k_node], 1))
+    k_node, has_any = u_masked_choice(s.take(L), mask)
+    carry_i = u_randint(s.take1(), jnp.maximum(tree.arity[k_node], 1))
+    _assert_consumed(s, u, "delete_node")
     carry = child[k_node, jnp.clip(carry_i, 0, MAX_ARITY - 1)]
     node_start, node_len = _span(size, k_node)
     carry_start, carry_len = _span(size, carry)
@@ -190,8 +241,8 @@ def delete_node(key, tree: TreeBatch, ctx: MutationContext, structure=None):
     return _select_tree(has_any, new_tree, tree), ok | ~has_any
 
 
-def _sample_leaf(keys, ctx: MutationContext, dtype):
-    """(op_code, feat, const) of one random leaf.
+def _sample_leaf(u4, ctx: MutationContext, dtype):
+    """(op_code, feat, const) of one random leaf from 4 uniforms.
 
     Non-parametric: 50/50 constant ~ randn / variable ~ uniform feature
     (src/MutationFunctions.jl:321-333). Parametric (n_params > 0): uniform
@@ -199,62 +250,59 @@ def _sample_leaf(keys, ctx: MutationContext, dtype):
     (make_random_leaf for ParametricNode,
     /root/reference/src/ParametricExpression.jl:113-137).
     """
-    val = jax.random.normal(keys[1], dtype=dtype)
-    f = randint_dyn(keys[2], ctx.nfeatures)
+    val = u_normal(u4[1]).astype(dtype)
+    f = u_randint(u4[2], ctx.nfeatures)
     if ctx.n_params > 0:
-        choice = randint_dyn(keys[0], 3)
-        p = randint_dyn(keys[3], ctx.n_params)
+        choice = u_randint(u4[0], 3)
+        p = u_randint(u4[3], ctx.n_params)
         code = jnp.where(
             choice == 0, LEAF_CONST, jnp.where(choice == 1, LEAF_VAR, LEAF_PARAM)
         )
         is_const = choice == 0
         feat = jnp.where(choice == 1, f, jnp.where(choice == 2, p, 0))
     else:
-        is_const = jax.random.bernoulli(keys[0])
+        is_const = u_bernoulli(u4[0])
         code = jnp.where(is_const, LEAF_CONST, LEAF_VAR)
         feat = jnp.where(is_const, 0, f)
     return code, feat, jnp.where(is_const, val, jnp.zeros((), dtype))
 
 
-def _make_leaf_scratch(key, n_slots, ctx: MutationContext, dtype):
-    """Scratch arrays holding `n_slots` random leaves + one op slot.
+def _make_leaf_scratch(u, ctx: MutationContext, dtype):
+    """Scratch arrays holding MAX_ARITY random leaves + one op slot from
+    ``_SCRATCH_NU`` uniforms.
 
     Layout: slots [0..MAX_ARITY-1] are random leaves (_sample_leaf); slot
     MAX_ARITY is reserved for a new operator node written by callers.
     """
     S = MAX_ARITY + 1
-    keys = jax.random.split(key, MAX_ARITY * 4)
     arity = jnp.zeros((S,), jnp.int32)
     op = jnp.zeros((S,), jnp.int32)
     feat = jnp.zeros((S,), jnp.int32)
     const = jnp.zeros((S,), dtype)
     for j in range(MAX_ARITY):
-        code, fj, cj = _sample_leaf(keys[4 * j:4 * j + 4], ctx, dtype)
+        code, fj, cj = _sample_leaf(u[4 * j:4 * j + 4], ctx, dtype)
         op = op.at[j].set(code)
         feat = feat.at[j].set(fj)
         const = const.at[j].set(cj)
     return arity, op, feat, const
 
 
-def _sample_new_op(key, ctx: MutationContext, limit_arity=None):
+def _sample_new_op(u, ctx: MutationContext, limit_arity=None):
     """Sample (arity, op_index) proportional to per-arity op counts
-    (the csum draw at src/MutationFunctions.jl:209-221)."""
-    k1, k2 = jax.random.split(key)
+    (the csum draw at src/MutationFunctions.jl:209-221) from ``2 * D``
+    uniforms."""
     D = len(ctx.nops)
+    s = USlice(u)
     weights = jnp.asarray(ctx.nops, jnp.float32)
     if limit_arity is not None:
         weights = jnp.where(jnp.arange(1, D + 1) <= limit_arity, weights, 0.0)
     total = jnp.sum(weights)
-    logits = jnp.where(weights > 0, jnp.log(jnp.maximum(weights, 1e-30)), -jnp.inf)
-    a = jax.random.categorical(k1, logits).astype(jnp.int32) + 1
-    samples = [
-        randint_dyn(jax.random.fold_in(k2, d), max(n, 1))
-        for d, n in enumerate(ctx.nops, start=1)
-    ]
+    a = u_categorical_weights(s.take(D), weights) + 1
+    u_ops = s.take(D)
     o = jnp.int32(0)
-    for d, s in enumerate(samples, start=1):
-        o = jnp.where(a == d, s, o)
-    return a, o, total > 0
+    for d, n in enumerate(ctx.nops, start=1):
+        o = jnp.where(a == d, u_randint(u_ops[d - 1], max(n, 1)), o)
+    return a.astype(jnp.int32), o, total > 0
 
 
 def _expand_leaf_pieces(tree, scratch, k_node, node_start, node_len, new_arity,
@@ -293,24 +341,32 @@ def _write_op_slot(scratch, a, o):
     return arity, op, feat, const
 
 
-def add_node(key, tree: TreeBatch, ctx: MutationContext, structure=None):
+def add_node(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     """append/prepend a random op, 50/50 (src/Mutate.jl:479-497)."""
-    k0, k1 = jax.random.split(key)
-    do_append = jax.random.bernoulli(k0)
-    appended, ok_a = append_random_op(k1, tree, ctx, structure)
-    prepended, ok_p = prepend_random_op(k1, tree, ctx)
+    L, D = ctx.max_nodes, len(ctx.nops)
+    s = USlice(u)
+    do_append = u_bernoulli(s.take1())
+    appended, ok_a = append_random_op(
+        s.take(L + 2 * D + _SCRATCH_NU), tree, ctx, structure
+    )
+    prepended, ok_p = prepend_random_op(
+        s.take(2 * D + 1 + _SCRATCH_NU), tree, ctx
+    )
+    _assert_consumed(s, u, "add_node")
     out = _select_tree(do_append, appended, prepended)
     return out, jnp.where(do_append, ok_a, ok_p)
 
 
-def append_random_op(key, tree: TreeBatch, ctx: MutationContext, structure=None):
+def append_random_op(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     """Replace a random leaf with op(random leaves) (:199-226)."""
-    k1, k2, k3 = jax.random.split(key, 3)
+    L, D = ctx.max_nodes, len(ctx.nops)
+    s = USlice(u)
     child, size, _ = _structure(tree, structure)
     mask = _slot_mask(tree) & (tree.arity == 0)
-    k_leaf, has_any = masked_choice(k1, mask)
-    a, o, any_op = _sample_new_op(k2, ctx)
-    scratch = _make_leaf_scratch(k3, MAX_ARITY, ctx, tree.const.dtype)
+    k_leaf, has_any = u_masked_choice(s.take(L), mask)
+    a, o, any_op = _sample_new_op(s.take(2 * D), ctx)
+    scratch = _make_leaf_scratch(s.take(_SCRATCH_NU), ctx, tree.const.dtype)
+    _assert_consumed(s, u, "append_random_op")
     scratch = _write_op_slot(scratch, a, o)
     new_tree, ok = _expand_leaf_pieces(
         tree, scratch, k_leaf, k_leaf, jnp.int32(1), a, jnp.int32(-1), ctx
@@ -319,15 +375,17 @@ def append_random_op(key, tree: TreeBatch, ctx: MutationContext, structure=None)
     return _select_tree(valid, new_tree, tree), ok | ~valid
 
 
-def insert_random_op(key, tree: TreeBatch, ctx: MutationContext, structure=None):
+def insert_random_op(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     """Wrap a random node inside a new op (:243-272)."""
-    k1, k2, k3, k4 = jax.random.split(key, 4)
+    L, D = ctx.max_nodes, len(ctx.nops)
+    s = USlice(u)
     child, size, _ = _structure(tree, structure)
     mask = _slot_mask(tree)
-    k_node, has_any = masked_choice(k1, mask)
-    a, o, any_op = _sample_new_op(k2, ctx)
-    carry = randint_dyn(k4, jnp.maximum(a, 1))
-    scratch = _make_leaf_scratch(k3, MAX_ARITY, ctx, tree.const.dtype)
+    k_node, has_any = u_masked_choice(s.take(L), mask)
+    a, o, any_op = _sample_new_op(s.take(2 * D), ctx)
+    carry = u_randint(s.take1(), jnp.maximum(a, 1))
+    scratch = _make_leaf_scratch(s.take(_SCRATCH_NU), ctx, tree.const.dtype)
+    _assert_consumed(s, u, "insert_random_op")
     scratch = _write_op_slot(scratch, a, o)
     node_start, node_len = _span(size, k_node)
     new_tree, ok = _expand_leaf_pieces(
@@ -337,12 +395,14 @@ def insert_random_op(key, tree: TreeBatch, ctx: MutationContext, structure=None)
     return _select_tree(valid, new_tree, tree), ok | ~valid
 
 
-def prepend_random_op(key, tree: TreeBatch, ctx: MutationContext):
+def prepend_random_op(u, tree: TreeBatch, ctx: MutationContext):
     """New root with the old tree as a random child (:289-319)."""
-    k1, k2, k3 = jax.random.split(key, 3)
-    a, o, any_op = _sample_new_op(k1, ctx)
-    carry = randint_dyn(k2, jnp.maximum(a, 1))
-    scratch = _make_leaf_scratch(k3, MAX_ARITY, ctx, tree.const.dtype)
+    D = len(ctx.nops)
+    s = USlice(u)
+    a, o, any_op = _sample_new_op(s.take(2 * D), ctx)
+    carry = u_randint(s.take1(), jnp.maximum(a, 1))
+    scratch = _make_leaf_scratch(s.take(_SCRATCH_NU), ctx, tree.const.dtype)
+    _assert_consumed(s, u, "prepend_random_op")
     scratch = _write_op_slot(scratch, a, o)
     new_tree, ok = _expand_leaf_pieces(
         tree, scratch, tree.length - 1, jnp.int32(0), tree.length, a, carry, ctx
@@ -350,7 +410,7 @@ def prepend_random_op(key, tree: TreeBatch, ctx: MutationContext):
     return _select_tree(any_op, new_tree, tree), ok | ~any_op
 
 
-def rotate_tree(key, tree: TreeBatch, ctx: MutationContext, structure=None):
+def rotate_tree(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     """AVL-style random rotation (randomly_rotate_tree!, :594-633).
 
     Chooses a rotation root R (an operator node with at least one operator
@@ -360,7 +420,7 @@ def rotate_tree(key, tree: TreeBatch, ctx: MutationContext, structure=None):
     spans — implemented as a 9-piece gather.
     """
     L = ctx.max_nodes
-    k1, k2, k3 = jax.random.split(key, 3)
+    s = USlice(u)
     child, size, _ = _structure(tree, structure)
     slot_ok = _slot_mask(tree)
     child_arity = tree.arity[jnp.clip(child, 0, L - 1)]  # [L, A]
@@ -368,12 +428,13 @@ def rotate_tree(key, tree: TreeBatch, ctx: MutationContext, structure=None):
         (child_arity > 0) & (jnp.arange(MAX_ARITY) < tree.arity[:, None]), axis=1
     )
     root_mask = slot_ok & (tree.arity > 0) & has_op_child
-    r, has_root = masked_choice(k1, root_mask)
+    r, has_root = u_masked_choice(s.take(L), root_mask)
 
     pivot_mask = (jnp.arange(MAX_ARITY) < tree.arity[r]) & (child_arity[r] > 0)
-    pi, _ = masked_choice(k2, pivot_mask)
+    pi, _ = u_masked_choice(s.take(MAX_ARITY), pivot_mask)
     p = child[r, pi]
-    gi = randint_dyn(k3, jnp.maximum(tree.arity[p], 1))
+    gi = u_randint(s.take1(), jnp.maximum(tree.arity[p], 1))
+    _assert_consumed(s, u, "rotate_tree")
     g = child[p, jnp.clip(gi, 0, MAX_ARITY - 1)]
 
     def span_of(x):
@@ -386,9 +447,9 @@ def rotate_tree(key, tree: TreeBatch, ctx: MutationContext, structure=None):
         in_use = i < tree.arity[r]
         ci = child[r, i]
         ci_start, ci_len = span_of(ci)
-        s = jnp.where(i == pi, g_start, ci_start)
+        st = jnp.where(i == pi, g_start, ci_start)
         ln = jnp.where(i == pi, g_len, ci_len)
-        rp_starts.append(jnp.where(in_use, s, 0))
+        rp_starts.append(jnp.where(in_use, st, 0))
         rp_lens.append(jnp.where(in_use, ln, 0))
     rp_starts.append(r)
     rp_lens.append(jnp.int32(1))
@@ -420,15 +481,16 @@ def rotate_tree(key, tree: TreeBatch, ctx: MutationContext, structure=None):
     return _select_tree(has_root, new_tree, tree), ok | ~has_root
 
 
-def crossover_trees(key, tree1: TreeBatch, tree2: TreeBatch, ctx: MutationContext,
+def crossover_trees(u, tree1: TreeBatch, tree2: TreeBatch, ctx: MutationContext,
                     structure1=None, structure2=None):
-    """Random subtree exchange (crossover_trees, :488-518)."""
+    """Random subtree exchange (crossover_trees, :488-518). ``u``: [2L]."""
     L = ctx.max_nodes
-    k1, k2 = jax.random.split(key)
+    s = USlice(u)
     _, size1, _ = _structure(tree1, structure1)
     _, size2, _ = _structure(tree2, structure2)
-    n1, _ = masked_choice(k1, _slot_mask(tree1))
-    n2, _ = masked_choice(k2, _slot_mask(tree2))
+    n1, _ = u_masked_choice(s.take(L), _slot_mask(tree1))
+    n2, _ = u_masked_choice(s.take(L), _slot_mask(tree2))
+    _assert_consumed(s, u, "crossover_trees")
     s1, l1 = _span(size1, n1)
     s2, l2 = _span(size2, n2)
     sources12 = combine_sources(tree1, tree2)
@@ -443,27 +505,26 @@ def crossover_trees(key, tree1: TreeBatch, tree2: TreeBatch, ctx: MutationContex
 # ---------------------------------------------------------------------------
 
 
-def _make_single_leaf(key, ctx: MutationContext, dtype):
-    keys = jax.random.split(key, 4)
-    code, f0, c0 = _sample_leaf(keys, ctx, dtype)
+def _make_single_leaf_u(u4, ctx: MutationContext, dtype):
+    code, f0, c0 = _sample_leaf(u4, ctx, dtype)
     L = ctx.max_nodes
-    t = TreeBatch(
+    return TreeBatch(
         arity=jnp.zeros((L,), jnp.int32),
         op=jnp.zeros((L,), jnp.int32).at[0].set(code),
         feat=jnp.zeros((L,), jnp.int32).at[0].set(f0),
         const=jnp.zeros((L,), dtype).at[0].set(c0),
         length=jnp.int32(1),
     )
-    return t
 
 
-def _random_postfix_from_counts(key, n_binary, n_unary, ctx: MutationContext,
+def _random_postfix_from_counts(u, n_binary, n_unary, ctx: MutationContext,
                                 dtype):
     """Uniform random postfix tree with the given operator-arity counts.
 
-    Loop-free construction (the reference grows trees by sequential leaf
-    expansion, src/MutationFunctions.jl:441-471; a sequential loop is
-    poison on TPU, so we sample the tree *shape* directly):
+    ``u``: [7L] uniforms. Loop-free construction (the reference grows
+    trees by sequential leaf expansion, src/MutationFunctions.jl:441-471;
+    a sequential loop is poison on TPU, so we sample the tree *shape*
+    directly):
 
     1. lay out the arity multiset (``n_binary`` 2s, ``n_unary`` 1s,
        ``n_binary + 1`` 0s) and shuffle it with a masked argsort;
@@ -478,7 +539,7 @@ def _random_postfix_from_counts(key, n_binary, n_unary, ctx: MutationContext,
     process, which biases toward unbalanced shapes.
     """
     L = ctx.max_nodes
-    k_perm, k_ops1, k_ops2, k_leaf = jax.random.split(key, 4)
+    s = USlice(u)
     slot = jnp.arange(L, dtype=jnp.int32)
     m = 2 * n_binary + n_unary + 1        # total nodes (traced scalar)
     live = slot < m
@@ -486,7 +547,7 @@ def _random_postfix_from_counts(key, n_binary, n_unary, ctx: MutationContext,
     vals = jnp.where(
         slot < n_binary, 2, jnp.where(slot < n_binary + n_unary, 1, 0)
     ).astype(jnp.int32)
-    prio = jnp.where(live, jax.random.uniform(k_perm, (L,)), 2.0)
+    prio = jnp.where(live, s.take(L), 2.0)
     perm = jnp.argsort(prio)
     arity = jnp.where(live, vals[perm], 0)
 
@@ -502,16 +563,17 @@ def _random_postfix_from_counts(key, n_binary, n_unary, ctx: MutationContext,
     # operator indices per arity
     nuna = ctx.nops[0] if len(ctx.nops) >= 1 else 0
     nbin = ctx.nops[1] if len(ctx.nops) >= 2 else 0
-    op_u = randint_dyn(k_ops1, max(nuna, 1), (L,))
-    op_b = randint_dyn(k_ops2, max(nbin, 1), (L,))
+    op_u = u_randint(s.take(L), max(nuna, 1))
+    op_b = u_randint(s.take(L), max(nbin, 1))
 
     # leaf payloads (vectorized _sample_leaf semantics)
-    ks = jax.random.split(k_leaf, 4)
-    const_vals = jax.random.normal(ks[1], (L,), dtype=dtype)
-    feat_vals = randint_dyn(ks[2], ctx.nfeatures, (L,))
+    u_choice = s.take(L)
+    const_vals = u_normal(s.take(L)).astype(dtype)
+    feat_vals = u_randint(s.take(L), ctx.nfeatures)
+    u_param = s.take(L)
     if ctx.n_params > 0:
-        choice = randint_dyn(ks[0], 3, (L,))
-        p_vals = randint_dyn(ks[3], ctx.n_params, (L,))
+        choice = u_randint(u_choice, 3)
+        p_vals = u_randint(u_param, ctx.n_params)
         leaf_code = jnp.where(
             choice == 0, LEAF_CONST, jnp.where(choice == 1, LEAF_VAR, LEAF_PARAM)
         )
@@ -519,7 +581,7 @@ def _random_postfix_from_counts(key, n_binary, n_unary, ctx: MutationContext,
                               jnp.where(choice == 2, p_vals, 0))
         is_const = choice == 0
     else:
-        is_const = jax.random.bernoulli(ks[0], shape=(L,))
+        is_const = u_choice < 0.5
         leaf_code = jnp.where(is_const, LEAF_CONST, LEAF_VAR)
         leaf_feat = jnp.where(is_const, 0, feat_vals)
 
@@ -534,18 +596,17 @@ def _random_postfix_from_counts(key, n_binary, n_unary, ctx: MutationContext,
                      length=m.astype(jnp.int32))
 
 
-def _sample_arity_counts(key, budget, ctx: MutationContext):
+def _sample_arity_counts(u_L, budget, ctx: MutationContext):
     """(n_binary, n_unary) from iid arity draws filling ``budget`` size
     increments (binary costs 2, unary 1), matching the reference growth
-    loop's weighted arity sampling in aggregate."""
-    L = ctx.max_nodes
+    loop's weighted arity sampling in aggregate. ``u_L``: [L] uniforms."""
     nuna = ctx.nops[0] if len(ctx.nops) >= 1 else 0
     nbin = ctx.nops[1] if len(ctx.nops) >= 2 else 0
     if nbin == 0 and nuna == 0:
         z = jnp.zeros((), jnp.int32)
         return z, z
     pb = nbin / max(nbin + nuna, 1)
-    draw_bin = jax.random.bernoulli(key, pb, (L,))
+    draw_bin = u_L < pb
     if nuna == 0:
         draw_bin = jnp.ones_like(draw_bin)
     if nbin == 0:
@@ -562,28 +623,37 @@ def _sample_arity_counts(key, budget, ctx: MutationContext):
     return n_binary, n_unary
 
 
+def _gen_random_tree_fixed_size_u(u, node_count, ctx: MutationContext, dtype):
+    """u: [8L] uniforms."""
+    s = USlice(u)
+    budget = jnp.clip(node_count, 1, ctx.max_nodes) - 1
+    n_binary, n_unary = _sample_arity_counts(s.take(ctx.max_nodes), budget, ctx)
+    return _random_postfix_from_counts(
+        s.take(7 * ctx.max_nodes), n_binary, n_unary, ctx, dtype
+    )
+
+
 def gen_random_tree_fixed_size(key, node_count, ctx: MutationContext, dtype,
                                n_steps=None):
     """Random tree of ~``node_count`` nodes
     (gen_random_tree_fixed_size, src/MutationFunctions.jl:441-471)."""
     del n_steps  # legacy knob of the sequential-growth implementation
-    k1, k2 = jax.random.split(key)
-    budget = jnp.clip(node_count, 1, ctx.max_nodes) - 1
-    n_binary, n_unary = _sample_arity_counts(k1, budget, ctx)
-    return _random_postfix_from_counts(k2, n_binary, n_unary, ctx, dtype)
+    u = jax.random.uniform(key, (gen_tree_nu(ctx),))
+    return _gen_random_tree_fixed_size_u(u, node_count, ctx, dtype)
 
 
 def gen_random_tree(key, nlength, ctx: MutationContext, dtype):
     """Random tree from ``nlength`` weighted op draws (gen_random_tree,
     :384-398 appends `nlength` ops; sizes land in [nlength+1, 2*nlength+1])."""
     L = ctx.max_nodes
-    k1, k2, k3 = jax.random.split(key, 3)
+    u = jax.random.uniform(key, (8 * L,))
+    s = USlice(u)
     nuna = ctx.nops[0] if len(ctx.nops) >= 1 else 0
     nbin = ctx.nops[1] if len(ctx.nops) >= 2 else 0
     if nbin == 0 and nuna == 0:
-        return _make_single_leaf(k1, ctx, dtype)
+        return _make_single_leaf_u(s.take(4), ctx, dtype)
     pb = nbin / max(nbin + nuna, 1)
-    draw_bin = jax.random.bernoulli(k1, pb, (L,))
+    draw_bin = s.take(L) < pb
     if nuna == 0:
         draw_bin = jnp.ones_like(draw_bin)
     if nbin == 0:
@@ -594,15 +664,20 @@ def gen_random_tree(key, nlength, ctx: MutationContext, dtype):
     take = (slot < n_ops) & (jnp.cumsum(cost) <= L - 1)
     n_binary = jnp.sum(take & draw_bin).astype(jnp.int32)
     n_unary = jnp.sum(take & ~draw_bin).astype(jnp.int32)
-    return _random_postfix_from_counts(k3, n_binary, n_unary, ctx, dtype)
+    return _random_postfix_from_counts(
+        s.take(7 * L), n_binary, n_unary, ctx, dtype
+    )
 
 
-def randomize_tree(key, tree: TreeBatch, cur_maxsize, ctx: MutationContext):
+def randomize_tree(u, tree: TreeBatch, cur_maxsize, ctx: MutationContext):
     """Replace with a fresh random tree of size ~U(1, curmaxsize)
-    (randomize_tree, :372-381)."""
-    k1, k2 = jax.random.split(key)
-    target = randint_dyn(k1, jnp.maximum(cur_maxsize, 1)) + 1
-    new_tree = gen_random_tree_fixed_size(k2, target, ctx, tree.const.dtype)
+    (randomize_tree, :372-381). ``u``: [1 + 8L]."""
+    s = USlice(u)
+    target = u_randint(s.take1(), jnp.maximum(cur_maxsize, 1)) + 1
+    new_tree = _gen_random_tree_fixed_size_u(
+        s.take(gen_tree_nu(ctx)), target, ctx, tree.const.dtype
+    )
+    _assert_consumed(s, u, "randomize_tree")
     return new_tree, jnp.bool_(True)
 
 

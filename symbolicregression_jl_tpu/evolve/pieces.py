@@ -57,24 +57,32 @@ def concat_pieces(
     loop).
     """
     s_arity, s_op, s_feat, s_const = sources
-    NP = starts.shape[0]
-    offs = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens).astype(jnp.int32)]
-    )
-    total = offs[-1]
+    ends = jnp.cumsum(lens).astype(jnp.int32)          # [NP] exclusive ends
+    begins = ends - lens                               # [NP] starts
+    total = ends[-1]
     ok = total <= max_nodes
     k = jnp.arange(max_nodes, dtype=jnp.int32)
-    # piece_id[k]: the piece covering output slot k.
-    piece_id = jnp.searchsorted(offs[1:], k, side="right").astype(jnp.int32)
-    piece_id = jnp.clip(piece_id, 0, NP - 1)
-    src = starts[piece_id] + (k - offs[piece_id])
-    src = jnp.clip(src, 0, s_arity.shape[0] - 1)
+    # TPU-friendly piece resolution: membership matrix + masked sum in
+    # place of searchsorted + gathers (both lower to slow scalar loops
+    # on TPU; these are pure vector compares/reduces). Zero-length
+    # pieces have begin == end and never match.
+    in_piece = (k[:, None] >= begins) & (k[:, None] < ends)      # [L, NP]
+    src = jnp.sum(
+        jnp.where(in_piece, starts + (k[:, None] - begins), 0), axis=1
+    )                                                            # [L]
     mask = k < total
+    # one-hot contraction instead of a dynamic gather
+    oh = src[:, None] == jnp.arange(s_arity.shape[0])            # [L, S]
+
+    def take(field, fill):
+        vals = jnp.sum(jnp.where(oh, field, 0), axis=1)
+        return jnp.where(mask, vals, fill).astype(field.dtype)
+
     tree = TreeBatch(
-        arity=jnp.where(mask, s_arity[src], 0),
-        op=jnp.where(mask, s_op[src], 0),
-        feat=jnp.where(mask, s_feat[src], 0),
-        const=jnp.where(mask, s_const[src], 0.0),
+        arity=take(s_arity, 0),
+        op=take(s_op, 0),
+        feat=take(s_feat, 0),
+        const=take(s_const, 0.0),
         length=jnp.minimum(total, max_nodes).astype(jnp.int32),
     )
     return tree, ok

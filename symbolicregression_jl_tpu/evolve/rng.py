@@ -11,7 +11,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["randint_dyn", "masked_choice", "categorical_from_weights"]
+__all__ = ["randint_dyn", "masked_choice", "categorical_from_weights",
+           "USlice", "u_randint", "u_masked_choice", "u_bernoulli",
+           "u_normal", "u_categorical_weights"]
 
 
 def randint_dyn(key, n, shape=()):
@@ -38,3 +40,63 @@ def categorical_from_weights(key, weights):
     """Sample an index proportional to non-negative ``weights`` (1-D)."""
     logits = jnp.where(weights > 0, jnp.log(jnp.maximum(weights, 1e-30)), -jnp.inf)
     return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Bulk-uniform randomness
+#
+# Chained `jax.random.split` / sampler calls cost one device op each; the
+# evolution step made ~1000 of them per cycle, dominating machinery time.
+# Instead each consumer takes static slices of ONE pre-generated uniform
+# vector and derives ints / Bernoullis / normals / categoricals with
+# plain arithmetic that fuses into its surroundings.
+# ---------------------------------------------------------------------------
+
+
+class USlice:
+    """Static-cursor view over a flat uniform(0,1) vector."""
+
+    def __init__(self, u):
+        self.u = u
+        self.i = 0
+
+    def take(self, n: int):
+        s = jax.lax.slice_in_dim(self.u, self.i, self.i + n)
+        self.i += n
+        return s
+
+    def take1(self):
+        return self.take(1)[0]
+
+
+def u_randint(u, n):
+    """Uniform int in [0, n) from one uniform scalar (traced n >= 1)."""
+    return jnp.minimum((u * n).astype(jnp.int32), jnp.asarray(n - 1, jnp.int32))
+
+
+def u_masked_choice(u_vec, mask):
+    """Uniform choice among True entries from a [len(mask)] uniform slice."""
+    has_any = jnp.any(mask)
+    idx = jnp.argmax(jnp.where(mask, u_vec, -1.0)).astype(jnp.int32)
+    return jnp.where(has_any, idx, 0), has_any
+
+
+def u_bernoulli(u, p=0.5):
+    return u < p
+
+
+def u_normal(u):
+    """Standard normal via the inverse CDF (elementwise, fusable)."""
+    from jax.scipy.special import ndtri
+
+    return ndtri(jnp.clip(u, 1e-7, 1.0 - 1e-7))
+
+
+def u_categorical_weights(u_vec, weights):
+    """Index ~ weights (1-D, non-negative) via the Gumbel trick on a
+    [len(weights)] uniform slice."""
+    g = -jnp.log(-jnp.log(jnp.clip(u_vec, 1e-12, 1.0 - 1e-7)))
+    logits = jnp.where(
+        weights > 0, jnp.log(jnp.maximum(weights, 1e-30)), -jnp.inf
+    )
+    return jnp.argmax(logits + g).astype(jnp.int32)

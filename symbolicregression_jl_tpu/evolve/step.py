@@ -194,8 +194,6 @@ def _condition_weights(base_w, tree: TreeBatch, complexity, cur_maxsize,
     too_big = complexity >= cur_maxsize
     w = setw(w, "add_node", jnp.where(too_big, zero, w[_KIND["add_node"]]))
     w = setw(w, "insert_node", jnp.where(too_big, zero, w[_KIND["insert_node"]]))
-    if not cfg.should_simplify:
-        w = setw(w, "simplify", zero)
     # GraphNode-only mutations are always off for tree expressions:
     w = setw(w, "form_connection", zero)
     w = setw(w, "break_connection", zero)
@@ -207,33 +205,43 @@ def _condition_weights(base_w, tree: TreeBatch, complexity, cur_maxsize,
 # ---------------------------------------------------------------------------
 
 
-def _apply_kind(kind, key, tree: TreeBatch, temperature, cur_maxsize,
+def _attempt_nu(cfg: EvolveConfig) -> int:
+    """Total uniform budget of one speculative mutation attempt."""
+    return sum(M.branch_nu(cfg.mctx).values())
+
+
+def _apply_kind(kind, u_all, tree: TreeBatch, temperature, cur_maxsize,
                 cfg: EvolveConfig, structure=None):
     """Apply mutation `kind` to `tree`; returns (tree, structural_ok).
 
-    ``structure`` is the precomputed (child, size, depth) of ``tree`` —
-    shared by every branch and every speculative attempt.
+    ``u_all`` is a flat uniform slice of size ``_attempt_nu(cfg)`` — one
+    bulk draw serves every branch. ``structure`` is the precomputed
+    (child, size, depth) of ``tree`` — shared by every branch and every
+    speculative attempt.
     """
+    from .rng import USlice
+
     mctx = cfg.mctx
+    budgets = M.branch_nu(mctx)
+    s = USlice(u_all)
     branches = []
 
     def add(name, fn):
-        branches.append((_KIND[name], fn))
+        branches.append((_KIND[name], fn(s.take(budgets[name]))))
 
-    add("mutate_constant", lambda k: M.mutate_constant(k, tree, temperature, mctx))
-    add("mutate_operator", lambda k: M.mutate_operator(k, tree, mctx))
-    add("mutate_feature", lambda k: M.mutate_feature(k, tree, mctx))
-    add("swap_operands", lambda k: M.swap_operands(k, tree, mctx, structure))
-    add("rotate_tree", lambda k: M.rotate_tree(k, tree, mctx, structure))
-    add("add_node", lambda k: M.add_node(k, tree, mctx, structure))
-    add("insert_node", lambda k: M.insert_random_op(k, tree, mctx, structure))
-    add("delete_node", lambda k: M.delete_node(k, tree, mctx, structure))
-    add("randomize", lambda k: M.randomize_tree(k, tree, cur_maxsize, mctx))
+    add("mutate_constant", lambda u: M.mutate_constant(u, tree, temperature, mctx))
+    add("mutate_operator", lambda u: M.mutate_operator(u, tree, mctx))
+    add("mutate_feature", lambda u: M.mutate_feature(u, tree, mctx))
+    add("swap_operands", lambda u: M.swap_operands(u, tree, mctx, structure))
+    add("rotate_tree", lambda u: M.rotate_tree(u, tree, mctx, structure))
+    add("add_node", lambda u: M.add_node(u, tree, mctx, structure))
+    add("insert_node", lambda u: M.insert_random_op(u, tree, mctx, structure))
+    add("delete_node", lambda u: M.delete_node(u, tree, mctx, structure))
+    add("randomize", lambda u: M.randomize_tree(u, tree, cur_maxsize, mctx))
 
     out_tree = tree
     out_ok = jnp.bool_(True)
-    for kid, fn in branches:
-        t, ok = fn(jax.random.fold_in(key, kid))
+    for kid, (t, ok) in branches:
         hit = kind == kid
         out_tree = M._select_tree(hit, t, out_tree)
         out_ok = jnp.where(hit, ok, out_ok)
@@ -351,8 +359,18 @@ def generation_step(
     tables: ComplexityTables,
     elementwise_loss,
     batch_idx=None,
+    marks=None,      # (simplify_mark [P], optimize_mark [P]) bools or None
 ) -> Tuple[PopulationState, jax.Array, jax.Array, jax.Array]:
-    """Returns (new_pop, num_evals, new_birth0, new_ref0)."""
+    """Returns (new_pop, num_evals, new_birth0, new_ref0[, new_marks]).
+
+    ``marks`` track members whose sampled mutation kind was `simplify` or
+    `optimize`. The reference applies those operations inline inside
+    `mutate!` (/root/reference/src/Mutate.jl:571-658); on TPU a per-slot
+    fold/BFGS would cost more than the whole cycle, so the member is kept
+    unchanged (the reference's return_immediately contract) and the mark
+    defers the actual operation to the iteration boundary, where folding
+    and constant optimization already run batched over the population.
+    """
     B = cfg.n_slots
     A = cfg.attempts
     P = cfg.population_size
@@ -367,11 +385,21 @@ def generation_step(
             maxsize=cfg.maxsize,
         )
 
+    from .rng import USlice, u_bernoulli, u_categorical_weights
+
+    NKINDS = len(MUTATION_KINDS)
+    ATT_NU = _attempt_nu(cfg)
+    L2 = 2 * cfg.max_nodes
+    # one bulk uniform draw covers every non-tournament decision of a slot
+    SLOT_NU = 1 + NKINDS + A * ATT_NU + A * L2 + 1 + 1 + 4
+
     def slot_fn(k):
-        ks = jax.random.split(k, 8)
-        is_xover = jax.random.bernoulli(ks[0], cfg.crossover_probability)
-        i1 = tourney(ks[1])
-        i2 = tourney(ks[2])
+        kt1, kt2, ku = jax.random.split(k, 3)
+        u = jax.random.uniform(ku, (SLOT_NU,))
+        s = USlice(u)
+        is_xover = u_bernoulli(s.take1(), cfg.crossover_probability)
+        i1 = tourney(kt1)
+        i2 = tourney(kt2)
         m1 = pop.member(i1)
         m2 = pop.member(i2)
 
@@ -380,7 +408,7 @@ def generation_step(
             jnp.asarray(options.mutation_weights.as_vector(), jnp.float32),
             m1.trees, m1.complexity, cur_maxsize, cfg,
         )
-        kind = categorical_from_weights(ks[3], w)
+        kind = u_categorical_weights(s.take(NKINDS), w)
         immediate = jnp.zeros((), jnp.bool_)
         for kid in _IMMEDIATE_KINDS:
             immediate = immediate | (kind == kid)
@@ -390,13 +418,13 @@ def generation_step(
         struct1 = M._tree_structure_single(m1.trees.arity, m1.trees.length)
         struct2 = M._tree_structure_single(m2.trees.arity, m2.trees.length)
 
-        att_keys = jax.random.split(ks[4], A)
+        att_u = s.take(A * ATT_NU).reshape(A, ATT_NU)
         att_trees, att_ok = jax.vmap(
-            lambda ak: _apply_kind(
-                kind, ak, m1.trees, temperature, cur_maxsize, cfg,
+            lambda au: _apply_kind(
+                kind, au, m1.trees, temperature, cur_maxsize, cfg,
                 structure=struct1,
             )
-        )(att_keys)
+        )(att_u)
         att_cons = check_constraints_batch(
             att_trees, options, tables, cur_maxsize
         )
@@ -406,26 +434,27 @@ def generation_step(
         # Parametric: mutate_constant takes the parameter-row branch half
         # the time, leaving the tree untouched
         # (/root/reference/src/ParametricExpression.jl:173-191).
+        u_pb = s.take1()
+        u_prow = s.take(4)
         mut_params = m1.params
         if cfg.n_params > 0:
-            kp1, kp2 = jax.random.split(ks[7])
             mutate_param = (
-                (kind == _KIND["mutate_constant"]) & jax.random.bernoulli(kp1)
+                (kind == _KIND["mutate_constant"]) & u_bernoulli(u_pb)
             )
             new_params = M.mutate_parameter_row(
-                kp2, m1.params, temperature, cfg.mctx
+                u_prow, m1.params, temperature, cfg.mctx
             )
             mut_params = jnp.where(mutate_param, new_params, m1.params)
             mut_tree = M._select_tree(mutate_param, m1.trees, mut_tree)
             mut_success = mut_success | mutate_param
 
         # ---- crossover path ----
-        xa_keys = jax.random.split(ks[5], A)
+        xa_u = s.take(A * L2).reshape(A, L2)
         c1s, c2s, ok1s, ok2s = jax.vmap(
-            lambda ak: M.crossover_trees(
-                ak, m1.trees, m2.trees, cfg.mctx, struct1, struct2
+            lambda au: M.crossover_trees(
+                au, m1.trees, m2.trees, cfg.mctx, struct1, struct2
             )
-        )(xa_keys)
+        )(xa_u)
         cons1 = check_constraints_batch(c1s, options, tables, cur_maxsize)
         cons2 = check_constraints_batch(c2s, options, tables, cur_maxsize)
         pair_valid = ok1s & ok2s & cons1 & cons2
@@ -443,12 +472,12 @@ def generation_step(
         return (
             is_xover, i1, i2, kind, immediate, mut_success, xo_success,
             cand1, cand2, cand1_params, cand2_params,
-            needs_eval1, needs_eval2, ks[6],
+            needs_eval1, needs_eval2, s.take1(),
         )
 
     (is_xover, i1, i2, kind, immediate, mut_success, xo_success,
      cand1, cand2, cand1_params, cand2_params,
-     needs_eval1, needs_eval2, accept_keys) = jax.vmap(slot_fn)(keys)
+     needs_eval1, needs_eval2, accept_u) = jax.vmap(slot_fn)(keys)
 
     # ---- one fused eval launch over all candidates ----
     both = jax.tree.map(
@@ -485,8 +514,7 @@ def generation_step(
             )
         prob = prob * (freq_of(m1_complexity) / jnp.maximum(freq_of(after_cx), 1e-12)
                        ).astype(prob.dtype)
-    u = jax.vmap(lambda k: jax.random.uniform(k))(accept_keys)
-    anneal_ok = u < jnp.where(jnp.isnan(prob), 0.0, prob)
+    anneal_ok = accept_u < jnp.where(jnp.isnan(prob), 0.0, prob)
     accepted_mut = mut_success & ~jnp.isnan(after_cost) & anneal_ok
 
     # Immediate kinds always "accept" the (unchanged) member, keeping its
@@ -562,7 +590,23 @@ def generation_step(
             pop.params, baby_params.reshape(nb, *baby_params.shape[2:])
         ),
     )
-    return new_pop, num_evals, birth0 + nb, ref0 + nb
+    if marks is None:
+        return new_pop, num_evals, birth0 + nb, ref0 + nb
+    # Deferred simplify/optimize marks ride the replacement scatter: the
+    # surviving copy of the member carries the flag; replaced slots that
+    # got ordinary babies are cleared.
+    simp_mark, opt_mark = marks
+    not_xover = ~is_xover
+    flag1_simp = not_xover & (kind == _KIND["simplify"]) & replace1
+    flag1_opt = not_xover & (kind == _KIND["optimize"]) & replace1
+    zeros2 = jnp.zeros_like(flag1_simp)
+    simp_flags = jnp.stack([flag1_simp, zeros2], axis=1).reshape(-1)
+    opt_flags = jnp.stack([flag1_opt, zeros2], axis=1).reshape(-1)
+    new_marks = (
+        scatter(simp_mark, simp_flags),
+        scatter(opt_mark, opt_flags),
+    )
+    return new_pop, num_evals, birth0 + nb, ref0 + nb, new_marks
 
 
 # ---------------------------------------------------------------------------
@@ -640,27 +684,30 @@ def s_r_cycle(
     batch_idx=None,
 ):
     """ncycles generation steps over the annealing ramp; returns
-    (pop, best_seen_hof, num_evals, birth0, ref0)."""
+    (pop, best_seen_hof, num_evals, birth0, ref0, marks)."""
     ncycles = cfg.ncycles
     hof0 = empty_hof(cfg.maxsize, cfg.max_nodes, pop.cost.dtype,
                      cfg.n_params, cfg.n_classes)
+    P = pop.cost.shape[0]
+    marks0 = (jnp.zeros((P,), jnp.bool_), jnp.zeros((P,), jnp.bool_))
 
     def cycle(carry, c):
-        pop, hof, birth, ref, nev = carry
+        pop, hof, birth, ref, nev, marks = carry
         if cfg.annealing and ncycles > 1:
             temperature = 1.0 - c.astype(pop.cost.dtype) / (ncycles - 1)
         else:
             temperature = jnp.asarray(1.0, pop.cost.dtype)
         k = jax.random.fold_in(key, c)
-        pop, nev_c, birth, ref = generation_step(
+        pop, nev_c, birth, ref, marks = generation_step(
             k, pop, data, stats_nf, temperature, cur_maxsize, birth, ref,
             cfg, options, tables, elementwise_loss, batch_idx=batch_idx,
+            marks=marks,
         )
         hof = update_hof(hof, pop, cfg.maxsize)
-        return (pop, hof, birth, ref, nev + nev_c), None
+        return (pop, hof, birth, ref, nev + nev_c, marks), None
 
-    (pop, hof, birth0, ref0, num_evals), _ = jax.lax.scan(
-        cycle, (pop, hof0, birth0, ref0, jnp.float32(0.0)),
+    (pop, hof, birth0, ref0, num_evals, marks), _ = jax.lax.scan(
+        cycle, (pop, hof0, birth0, ref0, jnp.float32(0.0), marks0),
         jnp.arange(ncycles, dtype=jnp.int32),
     )
-    return pop, hof, num_evals, birth0, ref0
+    return pop, hof, num_evals, birth0, ref0, marks

@@ -1,0 +1,99 @@
+"""simplify / optimize mutation kinds take real (deferred) effect.
+
+The reference applies these inline inside mutate!
+(/root/reference/src/Mutate.jl:571-658); the TPU engine marks the member
+during the cycle and applies folding / constant optimization at the
+iteration boundary (see generation_step's docstring).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.core.dataset import make_dataset
+from symbolicregression_jl_tpu.evolve.engine import Engine
+from symbolicregression_jl_tpu.ops.encoding import encode_population
+from symbolicregression_jl_tpu.ops.tree import parse_expression
+
+
+def _mk_data(n=64, nf=2, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, (n, nf)).astype(np.float32)
+    y = (3.0 * X[:, 0]).astype(np.float32)
+    return X, y
+
+
+def _weights_only(**kw):
+    base = {k: 0.0 for k in (
+        "mutate_constant", "mutate_operator", "mutate_feature",
+        "swap_operands", "rotate_tree", "add_node", "insert_node",
+        "delete_node", "simplify", "randomize", "do_nothing", "optimize",
+    )}
+    base.update(kw)
+    return base
+
+
+def test_simplify_kind_folds_marked_members():
+    X, y = _mk_data()
+    opts = Options(
+        binary_operators=["+", "*"], unary_operators=[],
+        maxsize=15, populations=1, population_size=8,
+        ncycles_per_iteration=30, tournament_selection_n=2,
+        crossover_probability=0.0,
+        should_simplify=False,             # only the mutation kind folds
+        should_optimize_constants=False,
+        migration=False, hof_migration=False,
+        mutation_weights=_weights_only(simplify=1.0),
+        save_to_file=False,
+    )
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(opts.elementwise_loss)
+    engine = Engine(opts, ds.nfeatures)
+
+    # all members: (1.0 + 2.0) * x1 — a foldable constant subtree
+    tree = parse_expression("(1.0 + 2.0) * x1", opts.operators)
+    trees = encode_population([tree] * 8, opts.maxsize, opts.operators)
+    trees = jax.tree.map(lambda x: x[None], trees)  # island axis
+    state = engine.init_state(jax.random.PRNGKey(0), ds.data, 1,
+                              initial_trees=trees)
+    assert int(jnp.max(state.pops.trees.length)) == 5
+
+    state = engine.run_iteration(state, ds.data, opts.maxsize)
+    lengths = np.asarray(state.pops.trees.length)[0]
+    # With simplify the only sampled kind and 30 cycles over 8 members,
+    # essentially every member should have been marked and folded to
+    # 3.0 * x1 (3 nodes).
+    assert (lengths == 3).sum() >= 6, lengths
+
+
+def test_optimize_kind_tunes_constants():
+    X, y = _mk_data()
+    opts = Options(
+        binary_operators=["+", "*"], unary_operators=[],
+        maxsize=15, populations=1, population_size=8,
+        ncycles_per_iteration=30, tournament_selection_n=2,
+        crossover_probability=0.0,
+        should_simplify=False,
+        optimizer_probability=0.0,          # only the mutation kind optimizes
+        optimizer_iterations=6,
+        mutation_weights=_weights_only(optimize=1.0),
+        save_to_file=False,
+    )
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(opts.elementwise_loss)
+    engine = Engine(opts, ds.nfeatures)
+
+    tree = parse_expression("1.1 * x1", opts.operators)  # true coef is 3.0
+    trees = encode_population([tree] * 8, opts.maxsize, opts.operators)
+    trees = jax.tree.map(lambda x: x[None], trees)
+    state = engine.init_state(jax.random.PRNGKey(0), ds.data, 1,
+                              initial_trees=trees)
+    loss_before = float(jnp.min(state.pops.loss))
+
+    state = engine.run_iteration(state, ds.data, opts.maxsize)
+    loss_after = float(jnp.min(state.pops.loss))
+    assert loss_after < 1e-6, (loss_before, loss_after)
+    # the tuned constant should be ~3.0
+    consts = np.asarray(state.pops.trees.const)[0]
+    assert np.any(np.isclose(consts, 3.0, atol=1e-3)), consts[:, :3]

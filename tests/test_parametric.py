@@ -166,7 +166,8 @@ def test_parameter_row_mutation():
     )
     params = jnp.ones((3, 4), jnp.float32)
     out = mutate_parameter_row(
-        jax.random.PRNGKey(0), params, jnp.float32(1.0), ctx
+        jax.random.uniform(jax.random.PRNGKey(0), (4,)), params,
+        jnp.float32(1.0), ctx
     )
     out = np.asarray(out)
     changed_rows = np.unique(np.where(out != 1.0)[0])

@@ -189,8 +189,11 @@ def test_dryrun_multichip_8_devices():
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import __graft_entry__ as ge
 
-    n = min(8, len(jax.devices()))
-    ge.dryrun_multichip(n)
+    # Demand the full 8-device mesh: in-process when the conftest's
+    # virtual CPU mesh is live, else via the dryrun's own subprocess
+    # self-provisioning.
+    assert len(jax.devices()) == 8, "conftest virtual mesh not engaged"
+    ge.dryrun_multichip(8)
 
 
 def test_entry_compiles():
