@@ -1,0 +1,58 @@
+"""Sweep bench-config knobs (islands, attempts, tournament) for evals/s.
+
+The bench metric counts full-dataset evals/s; machinery cost per cycle is
+partly per-op overhead on small tensors, so larger island counts amortize
+it. Run on the TPU: python profiling/config_sweep.py
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from _common import make_bench_problem
+
+
+def run(cfg_kw):
+    import jax
+
+    from symbolicregression_jl_tpu import search_key
+
+    options, ds, engine = make_bench_problem(ncycles_per_iteration=100, **cfg_kw)
+    state = engine.init_state(search_key(0), ds.data, options.populations)
+    state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    ev0 = float(state.num_evals)
+    t0 = time.perf_counter()
+    N = 3
+    for _ in range(N):
+        state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    dt = time.perf_counter() - t0
+    ev = float(state.num_evals) - ev0
+    print(f"{cfg_kw}: {ev / dt:10.0f} evals/s ({dt / N * 1e3:.0f} ms/iter)",
+          flush=True)
+
+
+def main():
+    configs = [
+        dict(populations=128, population_size=128, tournament_selection_n=8),
+        dict(populations=256, population_size=128, tournament_selection_n=8),
+        dict(populations=512, population_size=128, tournament_selection_n=8),
+        dict(populations=256, population_size=128, tournament_selection_n=8,
+             mutation_attempts=3),
+        dict(populations=512, population_size=128, tournament_selection_n=8,
+             mutation_attempts=3),
+        dict(populations=256, population_size=256, tournament_selection_n=16),
+    ]
+    if len(sys.argv) > 1:  # subset by index
+        configs = [configs[int(i)] for i in sys.argv[1:]]
+    for kw in configs:
+        try:
+            run(kw)
+        except Exception as e:  # noqa: BLE001
+            print(f"{kw}: FAIL {type(e).__name__}: {str(e)[:160]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""On-disk checkpoint/resume of the full search state.
+
+The reference resumes from saved output by re-parsing hall-of-fame CSVs
+and recomputing losses (/root/reference/src/SymbolicRegression.jl:760-821,
+SearchUtils.jl:532-555). The TPU engine's state is a pytree of arrays, so
+the full state (populations, hall of fame, adaptive-parsimony stats, RNG
+key) serializes exactly — resume continues the *identical* search, not a
+re-parse approximation. The CSV dumps remain alongside for
+interoperability.
+
+Format: one pickle file holding numpy-ified device states plus a
+compatibility header (the same fields the in-memory warm start checks,
+src/OptionsStruct.jl:314-336) so an incompatible resume fails with a
+clear error before any state is touched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from typing import TYPE_CHECKING, List
+
+import jax
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..core.options import Options
+    from .search import SearchState
+
+__all__ = ["save_search_state", "load_search_state", "options_compat_header"]
+
+_FORMAT_VERSION = 1
+
+
+def options_compat_header(options: "Options") -> dict:
+    """Comparable summary of the warm-start-compatibility fields.
+
+    Callables (custom operators, template combiners) can't be compared
+    across processes; we compare by name/shape instead.
+    """
+    spec = options.expression_spec
+    spec_desc: object = type(spec).__name__ if spec is not None else None
+    if spec is not None and hasattr(spec, "max_parameters"):
+        spec_desc = (spec_desc, spec.max_parameters)
+    if spec is not None and hasattr(spec, "structure"):
+        st = spec.structure
+        spec_desc = (
+            spec_desc, st.expr_keys, st.num_features, st.param_keys,
+            st.num_params, st.n_variables,
+        )
+    return {
+        "operators": (
+            tuple(op.name for op in options.operators.unary),
+            tuple(op.name for op in options.operators.binary),
+        ),
+        "maxsize": options.maxsize,
+        "maxdepth": options.maxdepth,
+        "loss_scale": options.loss_scale,
+        "parsimony": options.parsimony,
+        "dimensional_constraint_penalty": options.dimensional_constraint_penalty,
+        "batching": options.batching,
+        "batch_size": options.batch_size,
+        "population_size": options.population_size,
+        "populations": options.populations,
+        "expression_spec": spec_desc,
+    }
+
+
+def _to_numpy_state(ds):
+    """Device state -> picklable numpy pytree (typed PRNG key unwrapped)."""
+    ds = dataclasses.replace(ds, key=jax.random.key_data(ds.key))
+    return jax.tree.map(np.asarray, jax.device_get(ds))
+
+
+def _to_device_state(ds, key_impl: str = "rbg"):
+    return dataclasses.replace(
+        ds, key=jax.random.wrap_key_data(
+            jax.numpy.asarray(ds.key), impl=key_impl
+        )
+    )
+
+
+def save_search_state(path: str, state: "SearchState") -> None:
+    """Serialize a SearchState (the ``return_state=True`` result) to disk.
+
+    Double-write (tmp + atomic replace) matching the CSV checkpoint
+    discipline (src/SearchUtils.jl:605-649).
+    """
+    payload = {
+        "format_version": _FORMAT_VERSION,
+        "compat": options_compat_header(state.options),
+        "num_evals": float(state.num_evals),
+        "key_impl": "rbg",
+        "nfeatures": state.nfeatures,
+        "device_states": [_to_numpy_state(ds) for ds in state.device_states],
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".bak"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+
+
+def load_search_state(path: str, options: "Options") -> "SearchState":
+    """Load a checkpoint for resumption under ``options``.
+
+    Raises ValueError when the saved state is incompatible with the
+    given options (same contract as the in-memory warm start,
+    src/OptionsStruct.jl:314-336).
+    """
+    from .search import SearchState
+
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if payload.get("format_version") != _FORMAT_VERSION:
+        raise ValueError(
+            f"Unsupported checkpoint format: {payload.get('format_version')}"
+        )
+    saved = payload["compat"]
+    now = options_compat_header(options)
+    issues = [k for k in now if saved.get(k) != now[k]]
+    if issues:
+        raise ValueError(
+            f"Checkpoint incompatible with current options; changed: {issues}"
+        )
+    device_states = [
+        _to_device_state(ds, payload.get("key_impl", "rbg"))
+        for ds in payload["device_states"]
+    ]
+    return SearchState(
+        device_states=device_states,
+        hofs=[],  # rebuilt from device state on the first iteration
+        options=options,
+        num_evals=float(payload["num_evals"]),
+        nfeatures=payload.get("nfeatures"),
+    )

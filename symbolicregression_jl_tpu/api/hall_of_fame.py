@@ -34,7 +34,7 @@ __all__ = [
 class HallOfFameEntry:
     """One best-at-complexity member (PopMember analogue on host)."""
 
-    tree: Node
+    tree: Optional[Node]
     loss: float
     cost: float
     complexity: int
@@ -42,6 +42,18 @@ class HallOfFameEntry:
     # (n_params, n_classes) parameter matrix for parametric expressions
     # (/root/reference/src/ParametricExpression.jl:35-51), else None.
     params: Optional[np.ndarray] = None
+    # Template members decode to a HostTemplateExpression (named subtrees
+    # + parameter vectors); ``tree`` is None for those.
+    template_expr: Optional["object"] = None
+
+    def equation_string(self, variable_names=None, precision: int = 5) -> str:
+        if self.template_expr is not None:
+            # variable_names don't apply: template subexpressions print
+            # their argument slots as #1..#k by definition.
+            return self.template_expr.string(precision=precision)
+        return string_tree(
+            self.tree, variable_names=variable_names, precision=precision
+        )
 
     def __repr__(self) -> str:  # pragma: no cover
         return (
@@ -57,8 +69,14 @@ class HallOfFame:
     entries: List[HallOfFameEntry]
 
     @staticmethod
-    def from_device(hof_state, operators: OperatorSet) -> "HallOfFame":
-        """Decode a device HofState into host entries (existing only)."""
+    def from_device(hof_state, operators: OperatorSet,
+                    template=None) -> "HallOfFame":
+        """Decode a device HofState into host entries (existing only).
+
+        With ``template`` (a TemplateStructure), tree tensors carry a key
+        axis [maxsize, K, L]; each entry becomes a
+        HostTemplateExpression of named subtrees + parameter values.
+        """
         exists = np.asarray(hof_state.exists)
         cost = np.asarray(hof_state.cost)
         loss = np.asarray(hof_state.loss)
@@ -69,10 +87,35 @@ class HallOfFame:
         const = np.asarray(hof_state.trees.const)
         length = np.asarray(hof_state.trees.length)
         params = np.asarray(hof_state.params)
-        parametric = params.shape[-2] > 0
+        parametric = params.shape[-2] > 0 and template is None
         entries = []
         for i in range(exists.shape[0]):
             if not exists[i]:
+                continue
+            if template is not None:
+                from ..models.template import HostTemplateExpression
+
+                trees = {
+                    key: decode_tree(
+                        arity[i, k], op[i, k], feat[i, k], const[i, k],
+                        length[i, k], operators,
+                    )
+                    for k, key in enumerate(template.expr_keys)
+                }
+                entries.append(
+                    HallOfFameEntry(
+                        tree=None,
+                        loss=float(loss[i]),
+                        cost=float(cost[i]),
+                        complexity=int(complexity[i]),
+                        template_expr=HostTemplateExpression(
+                            trees=trees, structure=template,
+                            operators=operators,
+                            params=(params[i, :, 0]
+                                    if params.shape[-2] > 0 else None),
+                        ),
+                    )
+                )
                 continue
             tree = decode_tree(
                 arity[i], op[i], feat[i], const[i], length[i], operators
@@ -150,9 +193,7 @@ def string_dominating_pareto_curve(
     header = f"{'Complexity':<12}{'Loss':<12}{'Score':<12}Equation"
     lines.append("│ " + header.ljust(width - 2) + " │")
     for e in frontier:
-        eq = string_tree(
-            e.tree, variable_names=variable_names, precision=precision
-        )
+        eq = e.equation_string(variable_names=variable_names, precision=precision)
         row = (
             f"{e.complexity:<12d}{e.loss:<12.4g}{e.score:<12.4g}{eq}"
         )
@@ -175,13 +216,26 @@ def save_hall_of_fame_csv(
     """Write `Complexity,Loss,Equation` CSV with `.bak` double-write
     (save_to_file, src/SearchUtils.jl:605-649): write the backup first,
     then atomically move it over the target so a crash mid-write never
-    corrupts the existing file."""
-    rows = ["Complexity,Loss,Equation"]
+    corrupts the existing file.
+
+    Parametric entries get an extra `Parameters` column holding the
+    fitted (n_params x n_classes) bank as a flat ;-separated list, so the
+    CSV warm-start path can restore learned parameters instead of
+    reseeding them randomly."""
+    parametric = any(e.params is not None for e in hof.entries)
+    header = "Complexity,Loss,Equation"
+    rows = [header + ",Parameters" if parametric else header]
     for e in hof.entries:
-        eq = string_tree(
-            e.tree, variable_names=variable_names, precision=precision
-        )
-        rows.append(f'{e.complexity},{e.loss!r},"{eq}"')
+        eq = e.equation_string(variable_names=variable_names, precision=precision)
+        row = f'{e.complexity},{e.loss!r},"{eq}"'
+        if parametric:
+            p = (
+                ";".join(repr(float(v)) for v in np.asarray(e.params).ravel())
+                if e.params is not None
+                else ""
+            )
+            row += f',"{p}"'
+        rows.append(row)
     body = "\n".join(rows) + "\n"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     bak = path + ".bak"
@@ -194,23 +248,37 @@ def load_hall_of_fame_csv(
     path: str,
     operators: OperatorSet,
     variable_names: Optional[Sequence[str]] = None,
-) -> List[Node]:
+    return_params: bool = False,
+):
     """Parse a saved hall-of-fame CSV back into trees (warm start path,
-    load_saved_hall_of_fame, src/SearchUtils.jl:532-545)."""
+    load_saved_hall_of_fame, src/SearchUtils.jl:532-545).
+
+    ``return_params=True`` additionally returns the per-entry flat
+    parameter vectors from the `Parameters` column (None where absent),
+    so parametric warm starts restore fitted values."""
+    import csv as _csv
+
     trees: List[Node] = []
+    params: List[Optional[np.ndarray]] = []
     with open(path) as f:
-        header = f.readline()
-        if not header.startswith("Complexity"):
+        reader = _csv.reader(f)
+        header = next(reader, None)
+        if header is None or not header[0].startswith("Complexity"):
             raise ValueError(f"Not a hall-of-fame CSV: {path}")
-        for line in f:
-            line = line.strip()
-            if not line:
+        has_params = len(header) > 3 and header[3] == "Parameters"
+        for parts in reader:
+            if not parts:
                 continue
-            parts = line.split(",", 2)
             eq = parts[2].strip()
-            if eq.startswith('"') and eq.endswith('"'):
-                eq = eq[1:-1]
             trees.append(
                 parse_expression(eq, operators, variable_names=variable_names)
             )
+            if has_params and len(parts) > 3 and parts[3]:
+                params.append(
+                    np.asarray([float(v) for v in parts[3].split(";")])
+                )
+            else:
+                params.append(None)
+    if return_params:
+        return trees, params
     return trees

@@ -49,9 +49,11 @@ class EquationRecord:
     loss: float
     score: float
     equation: str
-    tree: Node
+    tree: Optional[Node]
     # (n_params, n_classes) for parametric expressions, else None.
     params: Optional[np.ndarray] = None
+    # HostTemplateExpression for template specs (tree is None then).
+    template_expr: Optional[Any] = None
 
 
 class SRRegressor:
@@ -215,11 +217,12 @@ class SRRegressor:
                     complexity=e.complexity,
                     loss=e.loss,
                     score=e.score,
-                    equation=string_tree(
-                        e.tree, variable_names=self.variable_names_
+                    equation=e.equation_string(
+                        variable_names=self.variable_names_
                     ),
                     tree=e.tree,
                     params=e.params,
+                    template_expr=e.template_expr,
                 )
                 for e in frontier
             ]
@@ -252,6 +255,13 @@ class SRRegressor:
         import jax.numpy as jnp
 
         rec = recs[idx]
+        if rec.template_expr is not None:
+            out = rec.template_expr(X)
+            if np.any(~np.isfinite(out)):
+                # prediction_fallback: zeros on invalid eval
+                # (src/MLJInterface.jl:431-456)
+                out = np.zeros(X.shape[0], out.dtype)
+            return out
         tree = rec.tree
         enc = encode_population(
             [tree], max(tree.count_nodes(), 1), self.options_.operators
@@ -264,6 +274,11 @@ class SRRegressor:
                     "predict requires `category=`"
                 )
             cat = np.asarray(category)
+            if cat.shape[0] != X.shape[0]:
+                raise ValueError(
+                    f"`category` has {cat.shape[0]} entries but X has "
+                    f"{X.shape[0]} rows — one category per row is required"
+                )
             cls = np.searchsorted(self.classes_, cat)
             cls = np.clip(cls, 0, rec.params.shape[1] - 1)
             unseen = self.classes_[cls] != cat

@@ -65,17 +65,33 @@ class RuntimeOptions:
     seed: Optional[int] = None
     logger: Optional[Any] = None  # SRLogger-compatible
     log_every_n: int = 1
+    # Interactive-quit stream (reference StdinReader,
+    # src/SearchUtils.jl:336-385). None = sys.stdin, engaged only when it
+    # is a TTY; pass a stream object to force-engage (tests).
+    input_stream: Optional[Any] = None
+    # Full-state checkpoint cadence (iterations) when save_to_file is on;
+    # the final/stopping iteration always checkpoints.
+    checkpoint_every_n: int = 5
 
 
 @dataclasses.dataclass
 class SearchState:
     """Host-side search state for warm starts (the `saved_state` analogue,
-    src/SymbolicRegression.jl:760-821)."""
+    src/SymbolicRegression.jl:760-821).
+
+    ``num_evals`` is the cumulative total across all prior runs; the
+    per-device counters inside ``device_states`` are reset when the state
+    is resumed (they only track the current run's evals).
+    """
 
     device_states: List[SearchDeviceState]  # one per output
     hofs: List[HallOfFame]
     options: Options
     num_evals: float = 0.0
+    # Per-output dataset feature counts: saved trees index features
+    # positionally, so resuming against a dataset with a different
+    # feature count would silently mis-evaluate.
+    nfeatures: Optional[List[int]] = None
 
 
 def _resolve_datasets(
@@ -159,6 +175,7 @@ def _seed_population(
     trees: Sequence[Node],
     data,
     mode: str,
+    params: Optional[Sequence[Optional[np.ndarray]]] = None,
 ) -> SearchDeviceState:
     """Inject host trees into the device population (guess seeding /
     initial_population, src/SearchUtils.jl:738-835 and the fork's
@@ -167,7 +184,9 @@ def _seed_population(
     ``mode='replace_worst'`` replaces the worst members of island 0 with
     the seeds (guess semantics: seeds then migrate outward);
     ``mode='tile'`` tiles seeds across all islands' member slots
-    (initial_population semantics).
+    (initial_population semantics). ``params``: optional per-seed fitted
+    parameter banks (flat or (n_params, n_classes)); seeds without one
+    get fresh randn banks.
     """
     if not trees:
         return state
@@ -179,7 +198,8 @@ def _seed_population(
     )
     n_seed = enc.length.shape[0]
     # Parametric: seeds get fresh randn parameter banks (extra_init_params
-    # with prototype=None, /root/reference/src/ParametricExpression.jl:35-51).
+    # with prototype=None, /root/reference/src/ParametricExpression.jl:35-51)
+    # unless a fitted bank is provided (CSV warm-start round trip).
     from ..evolve.population import init_params
 
     k_seed, k_next = jax.random.split(state.key)
@@ -187,6 +207,16 @@ def _seed_population(
     seed_params = init_params(
         k_seed, (n_seed,), engine.n_params, engine.n_classes, engine.dtype
     )
+    if params is not None and engine.n_params > 0:
+        sp = np.array(seed_params)  # writable host copy
+        for i, p in enumerate(list(params)[:n_seed]):
+            if p is None:
+                continue
+            p = np.asarray(p, sp.dtype).reshape(
+                engine.n_params, engine.n_classes
+            )
+            sp[i] = p
+        seed_params = jnp.asarray(sp)
     cost, loss, cx = engine._eval_cost(enc, data, seed_params)
 
     pops = state.pops
@@ -253,9 +283,8 @@ def equation_search(
     extra: Optional[Dict[str, Any]] = None,
     guesses: Optional[Sequence] = None,
     initial_population: Optional[Sequence] = None,
-    saved_state: Optional[SearchState] = None,
+    saved_state: Optional[Union[SearchState, str]] = None,
     runtime_options: Optional[RuntimeOptions] = None,
-    niche_datasets: Optional[Sequence[Dataset]] = None,
     verbosity: Optional[int] = None,
     progress: Optional[bool] = None,
     run_id: Optional[str] = None,
@@ -293,6 +322,21 @@ def equation_search(
         ropt.seed = seed
     elif ropt.seed is None:
         ropt.seed = options.seed
+    if options.deterministic and ropt.seed is None:
+        # The device evolution is always deterministic given the key; the
+        # only nondeterminism is the np.random seed fallback below. The
+        # reference enforces the same pairing (deterministic=true requires
+        # a seed, /root/reference/src/Configure.jl:64-66).
+        raise ValueError(
+            "deterministic=True requires a seed (pass seed= or Options(seed=...))"
+        )
+
+    if isinstance(saved_state, (str, os.PathLike)):
+        # On-disk checkpoint resume (the cross-process analogue of the
+        # reference's saved-output reload, src/SymbolicRegression.jl:760-821).
+        from .checkpoint import load_search_state
+
+        saved_state = load_search_state(os.fspath(saved_state), options)
 
     datasets = _resolve_datasets(
         X, y, weights, variable_names, display_variable_names,
@@ -335,11 +379,12 @@ def equation_search(
     engines: List[Engine] = []
     states: List[SearchDeviceState] = []
     datas = []
-    from ..models.spec import ParametricExpressionSpec
+    from ..models.spec import ParametricExpressionSpec, TemplateExpressionSpec
 
     for j, ds in enumerate(datasets):
         n_params = 0
         n_classes = 0
+        template = None
         if isinstance(options.expression_spec, ParametricExpressionSpec):
             if ds.data.class_idx is None:
                 raise ValueError(
@@ -350,8 +395,21 @@ def equation_search(
                 )
             n_params = options.expression_spec.max_parameters
             n_classes = ds.n_classes
+        elif isinstance(options.expression_spec, TemplateExpressionSpec):
+            template = options.expression_spec.structure
+            if ds.nfeatures != template.n_variables:
+                raise ValueError(
+                    f"Template combiner consumes {template.n_variables} "
+                    f"variables but the dataset has {ds.nfeatures} features"
+                )
+            if guesses is not None or initial_population:
+                raise NotImplementedError(
+                    "guesses / initial_population seeding is not yet "
+                    "supported for template expressions"
+                )
         engine = Engine(options, ds.nfeatures, dtype=_np_dtype(options.eval_dtype),
-                        n_params=n_params, n_classes=n_classes)
+                        n_params=n_params, n_classes=n_classes,
+                        template=template, n_data_shards=ropt.n_data_shards)
         data = shard_device_data(ds.data, mesh)
         key, k_init = jax.random.split(key)
         if saved_state is not None and j < len(saved_state.device_states):
@@ -360,7 +418,32 @@ def equation_search(
                 raise ValueError(
                     f"Warm start incompatible; changed options: {issues}"
                 )
+            if (
+                saved_state.nfeatures is not None
+                and saved_state.nfeatures[j] != ds.nfeatures
+            ):
+                raise ValueError(
+                    f"Warm start incompatible: saved state was fitted on "
+                    f"{saved_state.nfeatures[j]} features but the dataset "
+                    f"has {ds.nfeatures} (trees index features positionally)"
+                )
             state = saved_state.device_states[j]
+            # The saved per-device counters are already folded into
+            # saved_state.num_evals (num_evals0); reset them so the
+            # total isn't double-counted after resume.
+            state = dataclasses.replace(state, num_evals=jnp.float32(0.0))
+            if n_classes:
+                # Saved parametric banks are positional over the fitted
+                # class set; a different class count (or silently
+                # different class values) would misalign every learned
+                # parameter column.
+                saved_classes = state.pops.params.shape[-1]
+                if saved_classes != ds.n_classes:
+                    raise ValueError(
+                        f"Warm start incompatible: saved parametric state "
+                        f"has {saved_classes} classes but the dataset has "
+                        f"{ds.n_classes}"
+                    )
         else:
             state = engine.init_state(k_init, data, n_islands)
             if initial_population:
@@ -371,12 +454,23 @@ def equation_search(
                 state = _seed_population(engine, state, trees, data, mode="tile")
         if guesses is not None:
             gs = guesses[j] if _is_nested(guesses, len(datasets)) else guesses
-            trees = [
-                _parse_guess(g, options.operators, ds.variable_names, ds.nfeatures)
-                for g in gs
-            ]
+            # A guess is an expression (string/Node), or a tuple
+            # (expression, fitted_params) — the shape produced by
+            # load_hall_of_fame_csv(return_params=True).
+            trees, gparams = [], []
+            for g in gs:
+                if _is_guess_pair(g):
+                    expr, gp = g
+                else:
+                    expr, gp = g, None
+                trees.append(
+                    _parse_guess(expr, options.operators, ds.variable_names,
+                                 ds.nfeatures)
+                )
+                gparams.append(gp)
             state = _seed_population(
-                engine, state, trees, data, mode="replace_worst"
+                engine, state, trees, data, mode="replace_worst",
+                params=gparams,
             )
         state = shard_search_state(state, mesh)
         engines.append(engine)
@@ -391,6 +485,56 @@ def equation_search(
     recorder = Recorder(options) if options.use_recorder else None
     bar = ProgressBar(ropt.niterations) if ropt.progress else None
 
+    # Interactive quit ('q' / ctrl-d on stdin; StdinReader analogue).
+    from ..utils.stdin_quit import StdinQuitWatcher
+
+    watcher = StdinQuitWatcher(
+        ropt.input_stream, force=ropt.input_stream is not None
+    )
+
+    def _budget_stop(pending_evals=None) -> Optional[str]:
+        """``pending_evals``: optional thunk for not-yet-landed evals of a
+        partially-run iteration (only forced when max_evals is set)."""
+        if watcher.check():
+            return "user_quit"
+        if (
+            options.timeout_in_seconds is not None
+            and time.time() - start_time > options.timeout_in_seconds
+        ):
+            return "timeout"
+        if options.max_evals is not None:
+            evals = (
+                num_evals0
+                + (pending_evals() if pending_evals is not None else 0.0)
+                + sum(float(s.num_evals) for s in states)
+            )
+            if evals >= options.max_evals:
+                return "max_evals"
+        return None
+
+    # With a budget configured, split each iteration's evolve phase into
+    # chunks with the budget polled between launches, so a timeout /
+    # max_evals / user-quit can't overshoot by a whole iteration (the
+    # reference checks once per dispatched cycle batch,
+    # src/SymbolicRegression.jl:1202-1209). The engine keeps chunked and
+    # single-launch iterations bit-identical (global cycle indices; one
+    # epilogue), so this changes only check granularity, not results.
+    budgeted = (
+        options.timeout_in_seconds is not None
+        or options.max_evals is not None
+        or watcher.active
+    )
+    n_chunks = min(4, options.ncycles_per_iteration) if budgeted else 1
+    base, rem = divmod(options.ncycles_per_iteration, n_chunks)
+    chunk_sizes = [base + (1 if c < rem else 0) for c in range(n_chunks)]
+    chunk_sizes = [c for c in chunk_sizes if c > 0]
+
+    def _budget_hit(pending_evals=None) -> bool:
+        nonlocal stop_reason
+        if stop_reason is None:
+            stop_reason = _budget_stop(pending_evals)
+        return stop_reason is not None
+
     it = 0
     while it < ropt.niterations and stop_reason is None:
         cur_maxsize = get_cur_maxsize(
@@ -398,7 +542,11 @@ def equation_search(
             cycles_remaining,
         )
         for j, (engine, data) in enumerate(zip(engines, datas)):
-            states[j] = engine.run_iteration(states[j], data, cur_maxsize)
+            states[j] = engine.run_iteration(
+                states[j], data, cur_maxsize,
+                chunk_sizes=chunk_sizes if len(chunk_sizes) > 1 else None,
+                should_stop=_budget_hit,
+            )
         cycles_remaining -= options.ncycles_per_iteration
         it += 1
 
@@ -407,7 +555,9 @@ def equation_search(
             float(s.num_evals) for s in states
         )
         for j, (engine, ds) in enumerate(zip(engines, datasets)):
-            hofs[j] = HallOfFame.from_device(states[j].hof, options.operators)
+            hofs[j] = HallOfFame.from_device(
+                states[j].hof, options.operators, template=engine.template
+            )
             if out_dir is not None:
                 fname = (
                     "hall_of_fame.csv"
@@ -418,6 +568,29 @@ def equation_search(
                     os.path.join(out_dir, fname), hofs[j], options.operators,
                     variable_names=ds.variable_names,
                 )
+        if out_dir is not None and (
+            it % ropt.checkpoint_every_n == 0
+            or stop_reason is not None
+            or it == ropt.niterations
+        ):
+            # Full-state checkpoint next to the CSVs: kill the process at
+            # a checkpoint boundary and resume with
+            # equation_search(..., saved_state=<path>). Written every
+            # checkpoint_every_n iterations (not every iteration — the
+            # population pytree is much larger than the HoF CSVs) plus
+            # always at the final/stopping iteration.
+            from .checkpoint import save_search_state
+
+            save_search_state(
+                os.path.join(out_dir, "search_state.pkl"),
+                SearchState(
+                    device_states=list(states),
+                    hofs=hofs,
+                    options=options,
+                    num_evals=total_evals,
+                    nfeatures=[ds.nfeatures for ds in datasets],
+                ),
+            )
 
         if recorder is not None:
             for j, ds in enumerate(datasets):
@@ -456,14 +629,26 @@ def equation_search(
             )
             if hit:
                 stop_reason = "early_stop_condition"
-        if (
-            options.timeout_in_seconds is not None
-            and time.time() - start_time > options.timeout_in_seconds
-        ):
-            stop_reason = "timeout"
-        if options.max_evals is not None and total_evals >= options.max_evals:
-            stop_reason = "max_evals"
+        if stop_reason is None:
+            stop_reason = _budget_stop()
 
+    watcher.stop()
+    if out_dir is not None and it > 0:
+        # Guarantee the final/stopping state is checkpointed even when
+        # the stop was detected after the periodic write (early-stop
+        # condition or end-of-loop budget check).
+        from .checkpoint import save_search_state
+
+        save_search_state(
+            os.path.join(out_dir, "search_state.pkl"),
+            SearchState(
+                device_states=list(states),
+                hofs=hofs,
+                options=options,
+                num_evals=num_evals0 + sum(float(s.num_evals) for s in states),
+                nfeatures=[ds.nfeatures for ds in datasets],
+            ),
+        )
     if bar is not None:
         bar.close()
     if recorder is not None:
@@ -499,17 +684,34 @@ def equation_search(
             hofs=hofs,
             options=options,
             num_evals=num_evals0 + sum(float(s.num_evals) for s in states),
+            nfeatures=[ds.nfeatures for ds in datasets],
         )
         return host_state, result
     return result
 
 
+def _is_guess_pair(g) -> bool:
+    """An (expression, fitted_params) guess — the element shape produced
+    by load_hall_of_fame_csv(return_params=True)."""
+    return (
+        isinstance(g, tuple)
+        and len(g) == 2
+        and isinstance(g[0], (str, Node))
+        and (g[1] is None or isinstance(g[1], (np.ndarray, list)))
+    )
+
+
 def _is_nested(guesses, nout: int) -> bool:
+    """Per-output nested guesses (list of per-output guess lists) — an
+    (expr, params) pair is a single guess, never a nesting level."""
     return (
         nout > 1
         and isinstance(guesses, (list, tuple))
         and len(guesses) == nout
-        and all(isinstance(g, (list, tuple)) for g in guesses)
+        and all(
+            isinstance(g, (list, tuple)) and not _is_guess_pair(g)
+            for g in guesses
+        )
     )
 
 

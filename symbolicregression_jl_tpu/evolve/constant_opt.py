@@ -25,7 +25,8 @@ from ..ops.encoding import LEAF_CONST, TreeBatch, tree_structure_arrays
 from ..ops.eval import eval_single_tree
 from ..ops.fused_eval import fused_loss, fused_loss_and_const_grad
 
-__all__ = ["OptimizerConfig", "optimize_constants_batch", "optimize_constants_fused"]
+__all__ = ["OptimizerConfig", "optimize_constants_batch",
+           "optimize_constants_fused", "optimize_constants_template"]
 
 
 class OptimizerConfig(NamedTuple):
@@ -264,6 +265,94 @@ def optimize_constants_fused(
     new_const = jnp.where(improved[:, None] & cmask, x_best, trees.const)
     f_calls = jnp.sum(calls.reshape(P, R), axis=1) * do_opt
     return new_const, improved, jnp.where(improved, f_best, baseline), f_calls
+
+
+def optimize_constants_template(
+    key,
+    trees: TreeBatch,          # [P, K, L]
+    do_opt: jax.Array,         # [P] bool
+    data,
+    elementwise_loss,
+    operators,
+    cfg: OptimizerConfig,
+    template,                  # models.template.TemplateStructure
+    batch_idx: Optional[jax.Array] = None,
+    params: Optional[jax.Array] = None,   # [P, total_params, 1]
+):
+    """Joint optimization of every subexpression's constants plus the
+    template parameter vectors as one flat vector per member
+    (get_scalar_constants for TemplateExpression includes parameters,
+    /root/reference/src/TemplateExpression.jl:411-448).
+
+    Returns (new_const [P, K, L], improved [P], new_loss [P],
+    f_calls [P], new_params [P, total_params, 1]).
+    """
+    from ..models.template import eval_template_single
+
+    P, K, L = trees.arity.shape
+    T = template.total_params
+    if batch_idx is None:
+        X, y, w = data.Xt, data.y, data.weights
+    else:
+        X = jnp.take(data.Xt, batch_idx, axis=1)
+        y = jnp.take(data.y, batch_idx)
+        w = None if data.weights is None else jnp.take(data.weights, batch_idx)
+
+    def member_fn(k, arity, op, feat, const0, length, active, p0):
+        # arity.. [K, L]; p0 [T]
+        cmask = (
+            (jnp.arange(L)[None, :] < length[:, None])
+            & (arity == 0) & (op == LEAF_CONST)
+        )  # [K, L]
+        x0 = jnp.concatenate([const0.reshape(-1), p0])
+        mask = jnp.concatenate(
+            [cmask.reshape(-1), jnp.ones((T,), jnp.bool_)]
+        )
+
+        @jax.checkpoint
+        def f(x):
+            c = jnp.where(cmask, x[: K * L].reshape(K, L), const0)
+            member = TreeBatch(arity=arity, op=op, feat=feat, const=c,
+                               length=length)
+            pred, valid = eval_template_single(
+                member, X, template, operators,
+                params_flat=x[K * L:] if T else None,
+            )
+            return aggregate_loss(elementwise_loss, pred, y, valid, w)
+
+        baseline = f(x0)
+        eps = jax.random.normal(k, (cfg.nrestarts, K * L + T), x0.dtype)
+        starts = jnp.concatenate(
+            [x0[None], x0[None] * (1.0 + 0.5 * eps)], axis=0
+        )
+        xs, fs, calls = jax.vmap(
+            lambda x_init: _bfgs_minimize(f, x_init, mask, cfg)
+        )(starts)
+        best = jnp.argmin(jnp.where(jnp.isnan(fs), jnp.inf, fs))
+        x_best, f_best = xs[best], fs[best]
+        improved = active & (f_best < baseline) & jnp.isfinite(f_best)
+        new_const = jnp.where(
+            improved & cmask.reshape(-1), x_best[: K * L], const0.reshape(-1)
+        ).reshape(K, L)
+        new_p = jnp.where(improved, x_best[K * L:], p0)
+        return new_const, improved, jnp.where(improved, f_best, baseline), (
+            jnp.sum(calls) * active
+        ), new_p
+
+    keys = jax.random.split(key, P)
+    p_in = (
+        params[..., 0] if (params is not None and T > 0)
+        else jnp.zeros((P, T), trees.const.dtype)
+    )
+    new_const, improved, new_loss, f_calls, new_p = jax.vmap(member_fn)(
+        keys, trees.arity, trees.op, trees.feat, trees.const, trees.length,
+        do_opt, p_in,
+    )
+    new_params = (
+        new_p[..., None] if params is not None
+        else jnp.zeros((P, 0, 1), trees.const.dtype)
+    )
+    return new_const, improved, new_loss, f_calls, new_params
 
 
 def optimize_constants_batch(

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -89,12 +89,19 @@ class Engine:
 
     def __init__(self, options: Options, nfeatures: int, dtype=jnp.float32,
                  window_size: int = 100_000, n_params: int = 0,
-                 n_classes: int = 0):
+                 n_classes: int = 0, template=None, n_data_shards: int = 1):
         self.options = options
         self.nfeatures = nfeatures
         self.dtype = dtype
+        self.template = template
+        if template is not None:
+            # Template parameters ride the per-member parameter storage
+            # as a flat [total_params, 1] bank.
+            n_params = template.total_params
+            n_classes = 1 if n_params else 0
         self.cfg: EvolveConfig = evolve_config_from_options(
-            options, nfeatures, n_params, n_classes
+            options, nfeatures, n_params, n_classes, template=template,
+            n_data_shards=n_data_shards,
         )
         self.tables: ComplexityTables = build_complexity_tables(options, nfeatures)
         self.opt_cfg = OptimizerConfig(
@@ -115,6 +122,7 @@ class Engine:
                 loss_function=self.options.resolved_loss_function,
                 dim_penalty=self.cfg.dim_penalty,
                 wildcard_constants=self.cfg.wildcard_constants,
+                template=self.cfg.template,
             )
         )
 
@@ -142,9 +150,18 @@ class Engine:
 
         if initial_trees is None:
             keys = jax.random.split(k_init, n_islands)
-            trees = jax.vmap(
-                lambda k: init_population(k, P, cfg.mctx, self.dtype)
-            )(keys)
+            if cfg.template is not None:
+                from .population import init_template_population
+
+                trees = jax.vmap(
+                    lambda k: init_template_population(
+                        k, P, cfg.template, cfg.mctx, self.dtype
+                    )
+                )(keys)
+            else:
+                trees = jax.vmap(
+                    lambda k: init_population(k, P, cfg.mctx, self.dtype)
+                )(keys)
         else:
             trees = initial_trees
         if initial_params is None:
@@ -162,6 +179,7 @@ class Engine:
                 loss_function=self.options.resolved_loss_function,
                 dim_penalty=cfg.dim_penalty,
                 wildcard_constants=cfg.wildcard_constants,
+                template=cfg.template,
             )
         )(trees, params)
 
@@ -184,7 +202,9 @@ class Engine:
         return SearchDeviceState(
             pops=pops,
             hof=empty_hof(cfg.maxsize, cfg.max_nodes, self.dtype,
-                          cfg.n_params, cfg.n_classes),
+                          cfg.n_params, cfg.n_classes,
+                          template_k=(cfg.template.n_subexpressions
+                                      if cfg.template else 0)),
             stats=stats,
             birth=jnp.full((n_islands,), P, jnp.int32),
             ref=jnp.full((n_islands,), P, jnp.int32),
@@ -194,17 +214,117 @@ class Engine:
 
     # ------------------------------------------------------------------
     def run_iteration(self, state: SearchDeviceState, data: DeviceData,
-                      cur_maxsize: int):
-        return self._iteration(state, data, jnp.int32(cur_maxsize))
+                      cur_maxsize: int,
+                      chunk_sizes: Optional[Sequence[int]] = None,
+                      should_stop=None):
+        """One full iteration.
+
+        ``chunk_sizes`` (summing to ``ncycles_per_iteration``) splits the
+        evolve phase into multiple launches with the host ``should_stop``
+        callback polled between them (budget checks — the reference
+        checks per dispatched cycle batch,
+        src/SymbolicRegression.jl:1202-1209). A stop mid-iteration skips
+        the remaining chunks but still runs the epilogue (optimize /
+        simplify / finalize / migrate) exactly once, so chunked and
+        single-launch iterations are otherwise bit-identical: the
+        annealing ramp and per-cycle RNG fold-ins use global cycle
+        indices.
+        """
+        if not chunk_sizes or list(chunk_sizes) == [self.cfg.ncycles]:
+            return self._iteration(state, data, jnp.int32(cur_maxsize))
+        assert sum(chunk_sizes) == self.cfg.ncycles, (
+            f"chunk_sizes {chunk_sizes} must sum to {self.cfg.ncycles}"
+        )
+        cfg = self.cfg
+        cur_maxsize = jnp.int32(cur_maxsize)
+        # Same key derivation as the single-launch path (bit-identical).
+        key, k_batch, k_cycle, k_opt, k_mig = jax.random.split(state.key, 5)
+        batch_idx = None
+        if cfg.batching:
+            batch_idx = jax.random.randint(
+                k_batch, (cfg.batch_size,), 0, data.y.shape[0]
+            )
+        pops, birth, ref = state.pops, state.birth, state.ref
+        carry = None
+        c0 = 0
+        for i, nc in enumerate(chunk_sizes):
+            fn = self._chunk_fn(nc, first=carry is None,
+                                batching=batch_idx is not None)
+            pops, best_seen, nev, birth, ref, marks = fn(
+                pops, birth, ref, state.stats.normalized_frequencies, data,
+                cur_maxsize, k_cycle, batch_idx, jnp.int32(c0), carry
+            )
+            carry = (best_seen, nev, marks)
+            c0 += nc
+            if should_stop is not None and i < len(chunk_sizes) - 1:
+                # Offer this iteration's partial evals lazily: only a
+                # max_evals budget needs them, and materializing the sum
+                # would force a blocking device sync per chunk for
+                # everyone else (quit/timeout polls stay sync-free).
+                eval_fraction = (
+                    cfg.batch_size / data.y.shape[0] if cfg.batching else 1.0
+                )
+                chunk_nev = nev
+
+                def pending(nv=chunk_nev, ef=eval_fraction):
+                    return float(jnp.sum(nv)) * ef
+
+                if should_stop(pending):
+                    break
+        evolved = (pops, best_seen, nev, birth, ref, marks)
+        return self._epilogue_fn(
+            state, data, cur_maxsize, evolved, key, k_opt, k_mig, batch_idx
+        )
+
+    def _chunk_fn(self, ncycles: int, first: bool, batching: bool):
+        """Jitted evolve-chunk for a given (static) chunk length."""
+        if not hasattr(self, "_chunk_cache"):
+            self._chunk_cache = {}
+        k = (ncycles, first, batching)
+        if k not in self._chunk_cache:
+            cfg = self.cfg._replace(ncycles=ncycles)
+            self._chunk_cache[k] = jax.jit(
+                lambda pops, birth, ref, stats_nf, data, cm, kc, bi, c0, carry:
+                self._evolve_part(pops, birth, ref, stats_nf, data, cm, kc,
+                                  bi, c0, carry, cfg)
+            )
+        return self._chunk_cache[k]
+
+    @property
+    def _epilogue_fn(self):
+        if not hasattr(self, "_epilogue_jit"):
+            self._epilogue_jit = jax.jit(
+                lambda state, data, cm, evolved, key, ko, km, bi:
+                self._epilogue_part(state, data, cm, evolved, key, ko, km,
+                                    bi, self.cfg)
+            )
+        return self._epilogue_jit
+
+    def _evolve_part(self, pops, birth, ref, stats_nf, data, cur_maxsize,
+                     k_cycle, batch_idx, c0, carry, cfg: EvolveConfig):
+        """The evolve phase: cfg.ncycles bulk generation steps for all
+        islands (one chunk). ``carry`` = (best_seen, nev, marks) from
+        prior chunks of the same iteration."""
+        I = birth.shape[0]
+        cycle_keys = jax.random.split(k_cycle, I)
+        total = self.cfg.ncycles  # the FULL iteration's cycle count
+
+        def island_cycle(k, pop, b, r, ci):
+            return s_r_cycle(
+                k, pop, data, stats_nf, cur_maxsize, b, r, cfg,
+                self.options, self.tables, self.options.elementwise_loss,
+                batch_idx=batch_idx, c0=c0, total_cycles=total, carry_in=ci,
+            )
+
+        if carry is None:
+            return jax.vmap(
+                lambda k, p, b, r: island_cycle(k, p, b, r, None)
+            )(cycle_keys, pops, birth, ref)
+        return jax.vmap(island_cycle)(cycle_keys, pops, birth, ref, carry)
 
     def _iteration_impl(self, state: SearchDeviceState, data: DeviceData,
-                        cur_maxsize):
-        cfg = self.cfg
-        options = self.options
-        tables = self.tables
-        el_loss = options.elementwise_loss
-        I = state.birth.shape[0]
-        P = cfg.population_size
+                        cur_maxsize, cfg: Optional[EvolveConfig] = None):
+        cfg = cfg if cfg is not None else self.cfg
 
         key, k_batch, k_cycle, k_opt, k_mig = jax.random.split(state.key, 5)
 
@@ -215,22 +335,34 @@ class Engine:
             batch_idx = jax.random.randint(
                 k_batch, (cfg.batch_size,), 0, data.y.shape[0]
             )
+
+        # ---- evolve all islands: ncycles bulk generation steps ----
+        evolved = self._evolve_part(
+            state.pops, state.birth, state.ref,
+            state.stats.normalized_frequencies, data, cur_maxsize,
+            k_cycle, batch_idx, jnp.int32(0), None, cfg,
+        )
+        return self._epilogue_part(
+            state, data, cur_maxsize, evolved, key, k_opt, k_mig, batch_idx,
+            cfg,
+        )
+
+    def _epilogue_part(self, state: SearchDeviceState, data: DeviceData,
+                       cur_maxsize, evolved, key, k_opt, k_mig, batch_idx,
+                       cfg: EvolveConfig):
+        """Everything after the cycles: optimize & simplify, full-dataset
+        finalize, lineage rotation, HoF merge, migration, running stats
+        (runs exactly once per iteration, chunked or not)."""
+        options = self.options
+        tables = self.tables
+        el_loss = options.elementwise_loss
+        I = state.birth.shape[0]
+        P = cfg.population_size
         eval_fraction = (
             cfg.batch_size / data.y.shape[0] if cfg.batching else 1.0
         )
 
-        # ---- evolve all islands: ncycles bulk generation steps ----
-        cycle_keys = jax.random.split(k_cycle, I)
-
-        def island_cycle(k, pop, birth, ref):
-            return s_r_cycle(
-                k, pop, data, state.stats.normalized_frequencies, cur_maxsize,
-                birth, ref, cfg, options, tables, el_loss, batch_idx=batch_idx,
-            )
-
-        pops, best_seen, nev, birth, ref, marks = jax.vmap(island_cycle)(
-            cycle_keys, state.pops, state.birth, state.ref
-        )
+        pops, best_seen, nev, birth, ref, marks = evolved
         simp_mark, opt_mark = marks  # [I, P] bools
         num_evals = state.num_evals + jnp.sum(nev) * eval_fraction
 
@@ -238,15 +370,27 @@ class Engine:
         # `simplify`-kind mutations are deferred to here (see
         # generation_step): with should_simplify the whole population is
         # folded anyway; otherwise fold just the marked members.
+        if cfg.template is not None:
+            # Template members fold per subexpression
+            # (simplify_tree! maps over the inner expressions,
+            # /root/reference/src/TemplateExpression.jl:881-891).
+            K = cfg.template.n_subexpressions
+            fold_nfeat = max(self.nfeatures, *cfg.template.num_features, 1)
+
+            def fold(trees):  # [I, P, K, L]
+                flat = trees.reshape(I, P * K)
+                out = jax.vmap(
+                    lambda t: fold_constants_batch(t, fold_nfeat, cfg.operators)
+                )(flat)
+                return out.reshape(I, P, K)
+        else:
+            fold = jax.vmap(
+                lambda t: fold_constants_batch(t, self.nfeatures, cfg.operators)
+            )
         if cfg.should_simplify:
-            folded = jax.vmap(
-                lambda t: fold_constants_batch(t, self.nfeatures, cfg.operators)
-            )(pops.trees)
-            pops = dataclasses.replace(pops, trees=folded)
+            pops = dataclasses.replace(pops, trees=fold(pops.trees))
         elif float(options.mutation_weights.simplify) > 0:
-            folded = jax.vmap(
-                lambda t: fold_constants_batch(t, self.nfeatures, cfg.operators)
-            )(pops.trees)
+            folded = fold(pops.trees)
             from .mutation import _select_tree
 
             pops = dataclasses.replace(
@@ -313,14 +457,30 @@ class Engine:
             else:
                 opt_keys = jax.random.split(ko2, I)
 
-                def island_opt(k, trees: TreeBatch, idx, g, p):
-                    sub = jax.tree.map(lambda x: jnp.take(x, idx, axis=0), trees)
-                    sub_p = jnp.take(p, idx, axis=0)
-                    return optimize_constants_batch(
-                        k, sub, g, data, el_loss,
-                        cfg.operators, self.opt_cfg, batch_idx=batch_idx,
-                        params=sub_p,
-                    )
+                if cfg.template is not None:
+                    from .constant_opt import optimize_constants_template
+
+                    def island_opt(k, trees: TreeBatch, idx, g, p):
+                        sub = jax.tree.map(
+                            lambda x: jnp.take(x, idx, axis=0), trees
+                        )
+                        sub_p = jnp.take(p, idx, axis=0)
+                        return optimize_constants_template(
+                            k, sub, g, data, el_loss, cfg.operators,
+                            self.opt_cfg, cfg.template,
+                            batch_idx=batch_idx, params=sub_p,
+                        )
+                else:
+                    def island_opt(k, trees: TreeBatch, idx, g, p):
+                        sub = jax.tree.map(
+                            lambda x: jnp.take(x, idx, axis=0), trees
+                        )
+                        sub_p = jnp.take(p, idx, axis=0)
+                        return optimize_constants_batch(
+                            k, sub, g, data, el_loss,
+                            cfg.operators, self.opt_cfg, batch_idx=batch_idx,
+                            params=sub_p,
+                        )
                 (new_const_sub, improved, _, f_calls,
                  new_params_sub) = jax.vmap(island_opt)(
                     opt_keys, pops.trees, sel_idx, gate, pops.params
@@ -347,6 +507,7 @@ class Engine:
                 loss_function=options.resolved_loss_function,
                 dim_penalty=cfg.dim_penalty,
                 wildcard_constants=cfg.wildcard_constants,
+                template=cfg.template,
             )
         )(pops.trees, pops.params)
         pops = dataclasses.replace(pops, cost=cost, loss=loss, complexity=cx)

@@ -61,10 +61,17 @@ __all__ = [
 
 
 class MutationContext(NamedTuple):
-    """Static + traced context shared by mutation kernels."""
+    """Static + traced context shared by mutation kernels.
+
+    ``nfeatures`` may be a *traced* scalar (template expressions mutate
+    one subexpression at a time, each with its own argument count —
+    get_nfeatures_for_mutation, /root/reference/src/TemplateExpression.jl:824-826);
+    all kernels handle both. A (possibly traced) value of 0 forces
+    constant leaves.
+    """
 
     nops: Tuple[int, ...]  # static per-arity operator counts (1-based arity)
-    nfeatures: int         # static
+    nfeatures: "int | jax.Array"  # static int or traced scalar
     max_nodes: int         # static (L)
     perturbation_factor: float
     probability_negate_constant: float
@@ -191,12 +198,14 @@ def mutate_feature(u, tree: TreeBatch, ctx: MutationContext):
     idx, has_any = u_masked_choice(s.take(ctx.max_nodes), mask)
     u_delta = s.take1()
     _assert_consumed(s, u, "mutate_feature")
-    if ctx.nfeatures <= 1:
+    if isinstance(ctx.nfeatures, int) and ctx.nfeatures <= 1:
         return tree, jnp.bool_(True)
+    nf = jnp.asarray(ctx.nfeatures, jnp.int32)
     # uniform among features != current (src/MutationFunctions.jl:181)
-    delta = u_randint(u_delta, ctx.nfeatures - 1) + 1
-    new_feat = (tree.feat[idx] + delta) % ctx.nfeatures
-    feat = jnp.where(has_any, tree.feat.at[idx].set(new_feat), tree.feat)
+    delta = u_randint(u_delta, jnp.maximum(nf - 1, 1)) + 1
+    new_feat = (tree.feat[idx] + delta) % jnp.maximum(nf, 1)
+    changed = has_any & (nf > 1)
+    feat = jnp.where(changed, tree.feat.at[idx].set(new_feat), tree.feat)
     return TreeBatch(tree.arity, tree.op, feat, tree.const, tree.length), jnp.bool_(True)
 
 
@@ -251,17 +260,18 @@ def _sample_leaf(u4, ctx: MutationContext, dtype):
     /root/reference/src/ParametricExpression.jl:113-137).
     """
     val = u_normal(u4[1]).astype(dtype)
-    f = u_randint(u4[2], ctx.nfeatures)
+    nf = jnp.asarray(ctx.nfeatures, jnp.int32)
+    f = u_randint(u4[2], jnp.maximum(nf, 1))
     if ctx.n_params > 0:
         choice = u_randint(u4[0], 3)
         p = u_randint(u4[3], ctx.n_params)
+        is_const = (choice == 0) | (nf <= 0)
         code = jnp.where(
-            choice == 0, LEAF_CONST, jnp.where(choice == 1, LEAF_VAR, LEAF_PARAM)
+            is_const, LEAF_CONST, jnp.where(choice == 1, LEAF_VAR, LEAF_PARAM)
         )
-        is_const = choice == 0
-        feat = jnp.where(choice == 1, f, jnp.where(choice == 2, p, 0))
+        feat = jnp.where(is_const, 0, jnp.where(choice == 1, f, p))
     else:
-        is_const = u_bernoulli(u4[0])
+        is_const = u_bernoulli(u4[0]) | (nf <= 0)
         code = jnp.where(is_const, LEAF_CONST, LEAF_VAR)
         feat = jnp.where(is_const, 0, f)
     return code, feat, jnp.where(is_const, val, jnp.zeros((), dtype))
@@ -567,21 +577,22 @@ def _random_postfix_from_counts(u, n_binary, n_unary, ctx: MutationContext,
     op_b = u_randint(s.take(L), max(nbin, 1))
 
     # leaf payloads (vectorized _sample_leaf semantics)
+    nf = jnp.asarray(ctx.nfeatures, jnp.int32)
     u_choice = s.take(L)
     const_vals = u_normal(s.take(L)).astype(dtype)
-    feat_vals = u_randint(s.take(L), ctx.nfeatures)
+    feat_vals = u_randint(s.take(L), jnp.maximum(nf, 1))
     u_param = s.take(L)
     if ctx.n_params > 0:
         choice = u_randint(u_choice, 3)
         p_vals = u_randint(u_param, ctx.n_params)
+        is_const = (choice == 0) | (nf <= 0)
         leaf_code = jnp.where(
-            choice == 0, LEAF_CONST, jnp.where(choice == 1, LEAF_VAR, LEAF_PARAM)
+            is_const, LEAF_CONST, jnp.where(choice == 1, LEAF_VAR, LEAF_PARAM)
         )
-        leaf_feat = jnp.where(choice == 1, feat_vals,
-                              jnp.where(choice == 2, p_vals, 0))
-        is_const = choice == 0
+        leaf_feat = jnp.where(is_const, 0,
+                              jnp.where(choice == 1, feat_vals, p_vals))
     else:
-        is_const = u_choice < 0.5
+        is_const = (u_choice < 0.5) | (nf <= 0)
         leaf_code = jnp.where(is_const, LEAF_CONST, LEAF_VAR)
         leaf_feat = jnp.where(is_const, 0, feat_vals)
 

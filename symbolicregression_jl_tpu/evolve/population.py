@@ -46,16 +46,23 @@ class PopulationState:
         return self.params.shape[-2]
 
     def member(self, idx) -> "PopulationState":
-        """Gather a single member (or indexed subset) along the member axis."""
+        """Gather a single member (or indexed subset) along the member axis.
+
+        Template populations carry an extra subexpression axis on the
+        trees ([..., P, K, L] with length [..., P, K]); the member axis
+        is located relative to the cost shape either way.
+        """
+        extra = self.trees.arity.ndim - self.cost.ndim - 1  # 0, or 1 (template)
         take = lambda x: jnp.take(x, idx, axis=-1)
-        take_tree = lambda x: jnp.take(x, idx, axis=-2)
+        take_tree = lambda x: jnp.take(x, idx, axis=-(2 + extra))
+        take_len = lambda x: jnp.take(x, idx, axis=-(1 + extra))
         return PopulationState(
             trees=TreeBatch(
                 arity=take_tree(self.trees.arity),
                 op=take_tree(self.trees.op),
                 feat=take_tree(self.trees.feat),
                 const=take_tree(self.trees.const),
-                length=take(self.trees.length),
+                length=take_len(self.trees.length),
             ),
             cost=take(self.cost),
             loss=take(self.loss),
@@ -92,3 +99,32 @@ def init_population(
     """
     keys = jax.random.split(key, population_size)
     return jax.vmap(lambda k: gen_random_tree(k, nlength, ctx, dtype))(keys)
+
+
+def init_template_population(
+    key: jax.Array,
+    population_size: int,
+    template,                 # models.template.TemplateStructure
+    ctx: MutationContext,
+    dtype,
+    nlength: int = 3,
+) -> TreeBatch:
+    """Random template members [P, K, L] — each key generated with its
+    own argument count (create_expression for TemplateExpression seeds
+    each subexpression independently,
+    /root/reference/src/TemplateExpression.jl:462-501)."""
+    subs = []
+    for k, nf in enumerate(template.num_features):
+        ctx_k = ctx._replace(nfeatures=nf, n_params=0)
+        kk = jax.random.fold_in(key, k)
+        keys = jax.random.split(kk, population_size)
+        subs.append(
+            jax.vmap(lambda kx: gen_random_tree(kx, nlength, ctx_k, dtype))(keys)
+        )
+    return TreeBatch(
+        arity=jnp.stack([t.arity for t in subs], axis=1),
+        op=jnp.stack([t.op for t in subs], axis=1),
+        feat=jnp.stack([t.feat for t in subs], axis=1),
+        const=jnp.stack([t.const for t in subs], axis=1),
+        length=jnp.stack([t.length for t in subs], axis=1),
+    )

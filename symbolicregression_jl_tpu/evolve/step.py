@@ -83,6 +83,10 @@ class EvolveConfig(NamedTuple):
     # parameter banks [n_params, n_classes]; 0 = plain expressions.
     n_params: int = 0
     n_classes: int = 0
+    # Template expressions (TemplateExpressionSpec): the static structure
+    # (combiner + per-key arities); trees gain a leading key axis [K, L]
+    # and params hold the flat template parameter bank [total, 1].
+    template: "object" = None  # Optional[TemplateStructure]
 
     @property
     def n_slots(self) -> int:
@@ -91,18 +95,22 @@ class EvolveConfig(NamedTuple):
 
     @property
     def mctx(self) -> M.MutationContext:
+        # Template parameters live in the structure's parameter vectors,
+        # not in tree leaves — no LEAF_PARAM sampling for templates.
         return M.MutationContext(
             nops=self.operators.nops_tuple(),
             nfeatures=self.nfeatures,
             max_nodes=self.max_nodes,
             perturbation_factor=self.perturbation_factor,
             probability_negate_constant=self.probability_negate_constant,
-            n_params=self.n_params,
+            n_params=0 if self.template is not None else self.n_params,
         )
 
 
 def evolve_config_from_options(options: Options, nfeatures: int,
-                               n_params: int = 0, n_classes: int = 0) -> EvolveConfig:
+                               n_params: int = 0, n_classes: int = 0,
+                               template=None,
+                               n_data_shards: int = 1) -> EvolveConfig:
     on_tpu = jax.default_backend() == "tpu"
     turbo = options.turbo if options.turbo is not None else on_tpu
     if turbo and not supports_fused_eval(options.operators):
@@ -111,6 +119,15 @@ def evolve_config_from_options(options: Options, nfeatures: int,
         turbo = False  # custom whole-prediction losses use the jnp path
     if n_params > 0:
         turbo = False  # parameter-leaf gather uses the jnp interpreter
+    if template is not None:
+        turbo = False  # combiner-driven eval uses the jnp interpreter
+    if n_data_shards > 1:
+        # Documented fallback: `pl.pallas_call` does not compose with
+        # GSPMD row-sharded operands (it would need a shard_map wrapper
+        # with per-shard loss partials); the jnp interpreter partitions
+        # cleanly over the data axis, with the final loss reduction
+        # lowering to a psum over ICI.
+        turbo = False
     return EvolveConfig(
         operators=options.operators,
         maxsize=options.maxsize,
@@ -145,6 +162,7 @@ def evolve_config_from_options(options: Options, nfeatures: int,
         wildcard_constants=not options.dimensionless_constants_only,
         n_params=n_params,
         n_classes=n_classes,
+        template=template,
     )
 
 
@@ -155,7 +173,10 @@ def evolve_config_from_options(options: Options, nfeatures: int,
 
 
 def _condition_weights(base_w, tree: TreeBatch, complexity, cur_maxsize,
-                       cfg: EvolveConfig):
+                       cfg: EvolveConfig, nfeat_dyn=None):
+    """``tree`` is the mutation target ([L]; for templates, the chosen
+    subexpression); ``nfeat_dyn`` overrides the static feature count with
+    the chosen key's arity (templates)."""
     L = cfg.max_nodes
     slot = jnp.arange(L)
     mask = slot < tree.length
@@ -186,10 +207,15 @@ def _condition_weights(base_w, tree: TreeBatch, complexity, cur_maxsize,
     # constant-count scaling (condition_mutate_constant!, :159-170);
     # parametric expressions skip it (the parametric overload is a no-op,
     # /root/reference/src/ParametricExpression.jl:101-112)
-    if cfg.n_params == 0:
+    # (templates also skip it: condition_mutate_constant! is a no-op,
+    # /root/reference/src/TemplateExpression.jl:869-879)
+    if cfg.n_params == 0 and cfg.template is None:
         w = setw(w, "mutate_constant",
                  w[_KIND["mutate_constant"]] * jnp.minimum(8, n_const) / 8.0)
-    if cfg.nfeatures <= 1:
+    if nfeat_dyn is not None:
+        w = setw(w, "mutate_feature",
+                 jnp.where(nfeat_dyn <= 1, zero, w[_KIND["mutate_feature"]]))
+    elif cfg.nfeatures <= 1:
         w = setw(w, "mutate_feature", zero)
     too_big = complexity >= cur_maxsize
     w = setw(w, "add_node", jnp.where(too_big, zero, w[_KIND["add_node"]]))
@@ -211,17 +237,18 @@ def _attempt_nu(cfg: EvolveConfig) -> int:
 
 
 def _apply_kind(kind, u_all, tree: TreeBatch, temperature, cur_maxsize,
-                cfg: EvolveConfig, structure=None):
+                cfg: EvolveConfig, structure=None, mctx=None):
     """Apply mutation `kind` to `tree`; returns (tree, structural_ok).
 
     ``u_all`` is a flat uniform slice of size ``_attempt_nu(cfg)`` — one
     bulk draw serves every branch. ``structure`` is the precomputed
     (child, size, depth) of ``tree`` — shared by every branch and every
-    speculative attempt.
+    speculative attempt. ``mctx`` overrides ``cfg.mctx`` (templates pass
+    a per-key traced ``nfeatures``).
     """
     from .rng import USlice
 
-    mctx = cfg.mctx
+    mctx = mctx if mctx is not None else cfg.mctx
     budgets = M.branch_nu(mctx)
     s = USlice(u_all)
     branches = []
@@ -262,6 +289,48 @@ def _check_single(tree: TreeBatch, options, tables, cur_maxsize):
     return ok[0]
 
 
+def template_check_batch(trees: TreeBatch, options, tables, cur_maxsize,
+                         template) -> jax.Array:
+    """check_constraints for template members
+    (/root/reference/src/TemplateExpression.jl:917-940): combined
+    complexity <= maxsize, per-subtree structural constraints, and no
+    subexpression using a feature beyond its declared arity
+    (has_invalid_variables, :942-967). ``trees``: [..., K, L]."""
+    from ..ops.encoding import LEAF_VAR
+
+    per = check_constraints_batch(trees, options, tables, cur_maxsize)  # [..., K]
+    cx = compute_complexity_batch(trees, tables)                        # [..., K]
+    ok = jnp.all(per, axis=-1) & (jnp.sum(cx, axis=-1) <= cur_maxsize)
+    nfeat = jnp.asarray(template.num_features, jnp.int32)               # [K]
+    L = trees.max_nodes
+    in_tree = jnp.arange(L) < trees.length[..., None]
+    bad_feat = (
+        in_tree & (trees.arity == 0) & (trees.op == LEAF_VAR)
+        & (trees.feat >= nfeat[:, None])
+    )
+    return ok & ~jnp.any(bad_feat, axis=(-1, -2))
+
+
+def _take_sub(trees: TreeBatch, k) -> TreeBatch:
+    """Subexpression k of a template member ([K, L] -> [L])."""
+    g = lambda x: jax.lax.dynamic_index_in_dim(x, k, axis=0, keepdims=False)
+    return TreeBatch(
+        arity=g(trees.arity), op=g(trees.op), feat=g(trees.feat),
+        const=g(trees.const), length=g(trees.length),
+    )
+
+
+def _put_sub(trees: TreeBatch, sub: TreeBatch, k) -> TreeBatch:
+    """Write subexpression k back into a template member."""
+    return TreeBatch(
+        arity=trees.arity.at[k].set(sub.arity),
+        op=trees.op.at[k].set(sub.op),
+        feat=trees.feat.at[k].set(sub.feat),
+        const=trees.const.at[k].set(sub.const),
+        length=trees.length.at[k].set(sub.length),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Cost evaluation
 # ---------------------------------------------------------------------------
@@ -270,7 +339,8 @@ def _check_single(tree: TreeBatch, options, tables, cur_maxsize):
 def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
                     operators, parsimony, batch_idx=None, member_params=None,
                     turbo=False, interpret=False, loss_function=None,
-                    dim_penalty=1000.0, wildcard_constants=True):
+                    dim_penalty=1000.0, wildcard_constants=True,
+                    template=None):
     """Batched eval_cost (src/LossFunctions.jl:193-209): (cost, loss, complexity).
 
     ``turbo`` routes through the fused Pallas eval+loss kernel (the hot
@@ -292,6 +362,37 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
         class_idx = (
             None if data.class_idx is None else jnp.take(data.class_idx, batch_idx)
         )
+    if template is not None:
+        # Template eval: combiner over subexpression callables
+        # (/root/reference/src/TemplateExpression.jl:684-711); complexity
+        # is the sum over subtrees (:552-562). Dimensional analysis does
+        # not apply to templates (the combiner output has no unit
+        # derivation) — documented API exclusion.
+        from ..models.template import eval_template_batch
+
+        t_params = (
+            member_params[..., :, 0]
+            if (member_params is not None and member_params.shape[-2] > 0)
+            else None
+        )
+        pred, valid = eval_template_batch(trees, X, template, operators,
+                                          params=t_params)
+        if loss_function is not None:
+            flat_pred = pred.reshape(-1, pred.shape[-1])
+            flat_valid = valid.reshape(-1)
+            loss = jax.vmap(lambda p, v: loss_function(p, y, w, v))(
+                flat_pred, flat_valid
+            ).reshape(valid.shape)
+            loss = jnp.where(
+                valid & ~jnp.isnan(loss), loss,
+                jnp.asarray(jnp.inf, loss.dtype),
+            )
+        else:
+            loss = aggregate_loss(elementwise_loss, pred, y, valid, w)
+        complexity = jnp.sum(compute_complexity_batch(trees, tables), axis=-1)
+        cost = loss_to_cost(loss, data.baseline_loss, data.use_baseline,
+                            complexity, parsimony)
+        return cost, loss, complexity
     params = None
     if member_params is not None and member_params.shape[-2] > 0:
         if class_idx is None:
@@ -385,13 +486,14 @@ def generation_step(
             maxsize=cfg.maxsize,
         )
 
-    from .rng import USlice, u_bernoulli, u_categorical_weights
+    from .rng import USlice, u_bernoulli, u_categorical_weights, u_randint
 
     NKINDS = len(MUTATION_KINDS)
     ATT_NU = _attempt_nu(cfg)
     L2 = 2 * cfg.max_nodes
+    TK = 2 if cfg.template is not None else 0  # template key draws
     # one bulk uniform draw covers every non-tournament decision of a slot
-    SLOT_NU = 1 + NKINDS + A * ATT_NU + A * L2 + 1 + 1 + 4
+    SLOT_NU = 1 + NKINDS + TK + A * ATT_NU + A * L2 + 1 + 1 + 4
 
     def slot_fn(k):
         kt1, kt2, ku = jax.random.split(k, 3)
@@ -403,11 +505,33 @@ def generation_step(
         m1 = pop.member(i1)
         m2 = pop.member(i2)
 
+        base_w = jnp.asarray(options.mutation_weights.as_vector(), jnp.float32)
+        if cfg.template is not None:
+            # Templates mutate ONE random subexpression
+            # (get_contents_for_mutation,
+            # /root/reference/src/TemplateExpression.jl:797-821); each key
+            # carries its own argument count for feature sampling.
+            K = cfg.template.n_subexpressions
+            u_tk = s.take(TK)
+            k1 = u_randint(u_tk[0], K)
+            k2 = u_randint(u_tk[1], K)
+            nfeat_arr = jnp.asarray(cfg.template.num_features, jnp.int32)
+            tgt1 = _take_sub(m1.trees, k1)
+            tgt2 = _take_sub(m2.trees, k2)
+            mctx1 = cfg.mctx._replace(nfeatures=nfeat_arr[k1])
+            w = _condition_weights(
+                base_w, tgt1, m1.complexity, cur_maxsize, cfg,
+                nfeat_dyn=nfeat_arr[k1],
+            )
+        else:
+            tgt1 = m1.trees
+            tgt2 = m2.trees
+            mctx1 = None
+            w = _condition_weights(
+                base_w, tgt1, m1.complexity, cur_maxsize, cfg,
+            )
+
         # ---- mutation path ----
-        w = _condition_weights(
-            jnp.asarray(options.mutation_weights.as_vector(), jnp.float32),
-            m1.trees, m1.complexity, cur_maxsize, cfg,
-        )
         kind = u_categorical_weights(s.take(NKINDS), w)
         immediate = jnp.zeros((), jnp.bool_)
         for kid in _IMMEDIATE_KINDS:
@@ -415,19 +539,25 @@ def generation_step(
 
         # One structure derivation serves all attempts and branches (the
         # input tree is the same); crossover reuses the same tuples below.
-        struct1 = M._tree_structure_single(m1.trees.arity, m1.trees.length)
-        struct2 = M._tree_structure_single(m2.trees.arity, m2.trees.length)
+        struct1 = M._tree_structure_single(tgt1.arity, tgt1.length)
+        struct2 = M._tree_structure_single(tgt2.arity, tgt2.length)
 
         att_u = s.take(A * ATT_NU).reshape(A, ATT_NU)
         att_trees, att_ok = jax.vmap(
             lambda au: _apply_kind(
-                kind, au, m1.trees, temperature, cur_maxsize, cfg,
-                structure=struct1,
+                kind, au, tgt1, temperature, cur_maxsize, cfg,
+                structure=struct1, mctx=mctx1,
             )
         )(att_u)
-        att_cons = check_constraints_batch(
-            att_trees, options, tables, cur_maxsize
-        )
+        if cfg.template is not None:
+            att_trees = jax.vmap(lambda t: _put_sub(m1.trees, t, k1))(att_trees)
+            att_cons = template_check_batch(
+                att_trees, options, tables, cur_maxsize, cfg.template
+            )
+        else:
+            att_cons = check_constraints_batch(
+                att_trees, options, tables, cur_maxsize
+            )
         att_valid = att_ok & att_cons
         mut_tree, mut_success = _first_valid(att_valid, att_trees, m1.trees)
 
@@ -449,14 +579,26 @@ def generation_step(
             mut_success = mut_success | mutate_param
 
         # ---- crossover path ----
+        # (templates: each member contributes its chosen subexpression —
+        # the keys may differ, validity is re-checked per key arity)
         xa_u = s.take(A * L2).reshape(A, L2)
         c1s, c2s, ok1s, ok2s = jax.vmap(
             lambda au: M.crossover_trees(
-                au, m1.trees, m2.trees, cfg.mctx, struct1, struct2
+                au, tgt1, tgt2, cfg.mctx, struct1, struct2
             )
         )(xa_u)
-        cons1 = check_constraints_batch(c1s, options, tables, cur_maxsize)
-        cons2 = check_constraints_batch(c2s, options, tables, cur_maxsize)
+        if cfg.template is not None:
+            c1s = jax.vmap(lambda t: _put_sub(m1.trees, t, k1))(c1s)
+            c2s = jax.vmap(lambda t: _put_sub(m2.trees, t, k2))(c2s)
+            cons1 = template_check_batch(
+                c1s, options, tables, cur_maxsize, cfg.template
+            )
+            cons2 = template_check_batch(
+                c2s, options, tables, cur_maxsize, cfg.template
+            )
+        else:
+            cons1 = check_constraints_batch(c1s, options, tables, cur_maxsize)
+            cons2 = check_constraints_batch(c2s, options, tables, cur_maxsize)
         pair_valid = ok1s & ok2s & cons1 & cons2
         xo1, xo_success = _first_valid(pair_valid, c1s, m1.trees)
         xo2, _ = _first_valid(pair_valid, c2s, m2.trees)
@@ -490,6 +632,7 @@ def generation_step(
         turbo=cfg.turbo, interpret=cfg.interpret,
         loss_function=options.resolved_loss_function,
         dim_penalty=cfg.dim_penalty, wildcard_constants=cfg.wildcard_constants,
+        template=cfg.template,
     )
     needs_eval = jnp.stack([needs_eval1, needs_eval2], axis=1)
     num_evals = jnp.sum(needs_eval.astype(jnp.float32))
@@ -626,9 +769,13 @@ class HofState:
 
 
 def empty_hof(maxsize: int, max_nodes: int, dtype,
-              n_params: int = 0, n_classes: int = 0) -> HofState:
+              n_params: int = 0, n_classes: int = 0,
+              template_k: int = 0) -> HofState:
+    """``template_k`` > 0 gives HoF trees the template key axis
+    [maxsize, K, L]."""
+    tree_shape = (maxsize, template_k) if template_k else (maxsize,)
     return HofState(
-        trees=TreeBatch.empty((maxsize,), max_nodes, dtype),
+        trees=TreeBatch.empty(tree_shape, max_nodes, dtype),
         cost=jnp.full((maxsize,), jnp.inf, dtype),
         loss=jnp.full((maxsize,), jnp.inf, dtype),
         complexity=jnp.zeros((maxsize,), jnp.int32),
@@ -682,22 +829,44 @@ def s_r_cycle(
     tables: ComplexityTables,
     elementwise_loss,
     batch_idx=None,
+    c0=None,
+    total_cycles: Optional[int] = None,
+    carry_in=None,
 ):
-    """ncycles generation steps over the annealing ramp; returns
-    (pop, best_seen_hof, num_evals, birth0, ref0, marks)."""
+    """``cfg.ncycles`` generation steps over the annealing ramp; returns
+    (pop, best_seen_hof, num_evals, birth0, ref0, marks).
+
+    Chunked execution (host budget checks between chunks): ``c0`` is the
+    global cycle offset, ``total_cycles`` the full iteration's cycle
+    count (annealing ramp + per-cycle key fold-in use the *global* index,
+    so chunked and single-launch iterations are bit-identical), and
+    ``carry_in`` = (best_seen, num_evals, marks) accumulated by prior
+    chunks.
+    """
     ncycles = cfg.ncycles
-    hof0 = empty_hof(cfg.maxsize, cfg.max_nodes, pop.cost.dtype,
-                     cfg.n_params, cfg.n_classes)
-    P = pop.cost.shape[0]
-    marks0 = (jnp.zeros((P,), jnp.bool_), jnp.zeros((P,), jnp.bool_))
+    total = total_cycles if total_cycles is not None else ncycles
+    if carry_in is not None:
+        hof0, nev0, marks0 = carry_in
+    else:
+        hof0 = empty_hof(
+            cfg.maxsize, cfg.max_nodes, pop.cost.dtype, cfg.n_params,
+            cfg.n_classes,
+            template_k=(cfg.template.n_subexpressions if cfg.template else 0),
+        )
+        P = pop.cost.shape[0]
+        marks0 = (jnp.zeros((P,), jnp.bool_), jnp.zeros((P,), jnp.bool_))
+        nev0 = jnp.float32(0.0)
+    if c0 is None:
+        c0 = jnp.int32(0)
 
     def cycle(carry, c):
         pop, hof, birth, ref, nev, marks = carry
-        if cfg.annealing and ncycles > 1:
-            temperature = 1.0 - c.astype(pop.cost.dtype) / (ncycles - 1)
+        gc = c + c0  # global cycle index
+        if cfg.annealing and total > 1:
+            temperature = 1.0 - gc.astype(pop.cost.dtype) / (total - 1)
         else:
             temperature = jnp.asarray(1.0, pop.cost.dtype)
-        k = jax.random.fold_in(key, c)
+        k = jax.random.fold_in(key, gc)
         pop, nev_c, birth, ref, marks = generation_step(
             k, pop, data, stats_nf, temperature, cur_maxsize, birth, ref,
             cfg, options, tables, elementwise_loss, batch_idx=batch_idx,
@@ -707,7 +876,7 @@ def s_r_cycle(
         return (pop, hof, birth, ref, nev + nev_c, marks), None
 
     (pop, hof, birth0, ref0, num_evals, marks), _ = jax.lax.scan(
-        cycle, (pop, hof0, birth0, ref0, jnp.float32(0.0), marks0),
+        cycle, (pop, hof0, birth0, ref0, nev0, marks0),
         jnp.arange(ncycles, dtype=jnp.int32),
     )
     return pop, hof, num_evals, birth0, ref0, marks

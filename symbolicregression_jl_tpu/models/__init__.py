@@ -1,9 +1,23 @@
 """Expression-family plugin layer (TPU analogue of the reference's L5,
-SURVEY.md §2.5): expression specs and parametric expressions."""
+SURVEY.md §2.5): expression specs, parametric expressions, and
+template/composable expressions."""
 
-from .spec import ExpressionSpec, ParametricExpressionSpec
+from .composable import ComposableExpression, ParamVec, ValidVector
+from .spec import ExpressionSpec, ParametricExpressionSpec, TemplateExpressionSpec
+from .template import (
+    TemplateStructure,
+    make_template_structure,
+    template_spec,
+)
 
 __all__ = [
     "ExpressionSpec",
     "ParametricExpressionSpec",
+    "TemplateExpressionSpec",
+    "TemplateStructure",
+    "make_template_structure",
+    "template_spec",
+    "ComposableExpression",
+    "ParamVec",
+    "ValidVector",
 ]

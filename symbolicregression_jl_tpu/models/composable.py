@@ -1,0 +1,252 @@
+"""ValidVector algebra + ComposableExpression — the building blocks of
+template expressions.
+
+TPU re-design of /root/reference/src/ComposableExpression.jl:
+
+- ``ValidVector`` (reference :143-165): a device array paired with a
+  validity flag. Operations propagate validity (all operands valid AND
+  the result finite, matching ``apply_operator``/``_apply_operator``,
+  reference :263-289). On TPU the flag is a traced bool scalar, so the
+  whole algebra stays inside one jitted program — no branching.
+- A vectorized operator surface (reference :353-388 overloads ~80 Base
+  ops): Python dunders for arithmetic plus module-level named functions
+  (``sin``, ``exp``, ``safe_log``...) drawn from the same safe-operator
+  registry as the search itself (ops/operators.py), so template
+  combiners see identical NaN-domain semantics as evolved trees.
+- ``ComposableExpression`` (reference :198-256): a host expression that
+  is *callable* — on data it evaluates, on other ComposableExpressions
+  it splices trees (feature ``i`` leaf <- ``i``-th argument's tree).
+  The device-side analogue used inside jitted template evaluation is
+  ``TreeCallable`` (built by models/template.py from postfix tensors).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.operators import OPERATOR_REGISTRY, OperatorSet
+from ..ops.tree import Node
+
+__all__ = ["ValidVector", "ComposableExpression", "apply_operator", "ParamVec"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ValidVector:
+    """Array data + validity flag (reference ComposableExpression.jl:143-165).
+
+    ``x``: the row vector [n]; ``valid``: traced bool scalar. Invalid
+    values poison everything downstream — the template eval returns
+    loss = Inf for the member, matching the reference's invalid => NaN
+    output contract (reference :169-186).
+    """
+
+    x: jax.Array
+    valid: jax.Array  # bool scalar
+
+    # -- arithmetic dunders (validity-propagating) --
+    def __add__(self, o): return apply_operator("+", self, o)
+    def __radd__(self, o): return apply_operator("+", o, self)
+    def __sub__(self, o): return apply_operator("-", self, o)
+    def __rsub__(self, o): return apply_operator("-", o, self)
+    def __mul__(self, o): return apply_operator("*", self, o)
+    def __rmul__(self, o): return apply_operator("*", o, self)
+    def __truediv__(self, o): return apply_operator("/", self, o)
+    def __rtruediv__(self, o): return apply_operator("/", o, self)
+    def __pow__(self, o): return apply_operator("^", self, o)
+    def __rpow__(self, o): return apply_operator("^", o, self)
+    def __neg__(self): return apply_operator("neg", self)
+    def __abs__(self): return apply_operator("abs", self)
+    def __mod__(self, o): return apply_operator("mod", self, o)
+
+    def __getitem__(self, idx):
+        # Row-indexed gather (ParamVector[ValidVector] pattern,
+        # reference TemplateExpression.jl:74-77) is on ParamVec; plain
+        # indexing of a ValidVector slices the data, validity unchanged.
+        return ValidVector(self.x[idx], self.valid)
+
+
+def _is_vv(v) -> bool:
+    return isinstance(v, ValidVector)
+
+
+def _all_finite(x) -> jax.Array:
+    return jnp.all(jnp.isfinite(x))
+
+
+def apply_operator(op: Union[str, Any], *args) -> ValidVector:
+    """Apply a (safe) operator elementwise with validity propagation
+    (apply_operator, reference ComposableExpression.jl:263-289).
+
+    ``op`` is a registry name (resolved through the same safe-op table
+    the search uses) or any jnp-traceable callable. Scalar operands
+    broadcast against ValidVector operands.
+    """
+    if isinstance(op, str):
+        from ..ops.operators import resolve_operator
+
+        fn = resolve_operator(op).fn
+    elif hasattr(op, "fn"):
+        fn = op.fn
+    else:
+        fn = op
+    vals = [a.x if _is_vv(a) else a for a in args]
+    out = fn(*vals)
+    valid = _all_finite(out)
+    for a in args:
+        if _is_vv(a):
+            valid = valid & a.valid
+    return ValidVector(jnp.asarray(out), valid)
+
+
+# Named function surface: sr.models.composable.sin(vv), exp(vv), ... —
+# mirrors the reference's vectorized Base-operator overloads (:353-388).
+def _make_named(name):
+    def f(*args):
+        return apply_operator(name, *args)
+
+    f.__name__ = name
+    f.__qualname__ = name
+    f.__doc__ = f"ValidVector-lifted `{name}` (validity-propagating)."
+    return f
+
+
+_NAMED_FNS = {}
+for _name in OPERATOR_REGISTRY:
+    if _name.isidentifier():
+        _NAMED_FNS[_name] = _make_named(_name)
+# Builtin-shadowing names (max, min, abs, round, pow, ...) stay out of the
+# module globals — they resolve through __getattr__ (PEP 562) instead, so
+# `from ...composable import max` still gives the lifted version while the
+# module's own code keeps the builtins.
+import builtins as _builtins
+
+globals().update(
+    {k: v for k, v in _NAMED_FNS.items() if not hasattr(_builtins, k)}
+)
+__all__ += sorted(_NAMED_FNS)
+
+
+def __getattr__(name):
+    try:
+        return _NAMED_FNS[name]
+    except KeyError:
+        raise AttributeError(name) from None
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ParamVec:
+    """A (read-only) parameter vector visible to template combiners
+    (ParamVector, reference TemplateExpression.jl:40-77).
+
+    Integer indexing gives a traced scalar; ``ValidVector`` indexing
+    gathers per-row (the reference's `pv[I::ValidVector]`, :74-77 — the
+    idiom for category-dependent parameters inside templates).
+    """
+
+    data: jax.Array  # [n_params]
+
+    def __getitem__(self, idx):
+        if _is_vv(idx):
+            gathered = self.data[
+                jnp.clip(idx.x.astype(jnp.int32), 0, self.data.shape[0] - 1)
+            ]
+            return ValidVector(gathered, idx.valid)
+        return self.data[idx]
+
+    def __len__(self):
+        return self.data.shape[0]
+
+    def __iter__(self):
+        return (self.data[i] for i in range(self.data.shape[0]))
+
+
+class ComposableExpression:
+    """Host-side callable/composable expression
+    (reference ComposableExpression.jl:198-256).
+
+    Wraps a host ``Node`` whose variable leaves are *argument slots*
+    ``#1..#k``. Calling with:
+
+    - other ComposableExpressions => tree splicing: argument-``i``
+      leaves are replaced by copies of ``args[i]``'s tree (:240-256);
+    - arrays / ValidVectors / scalars => evaluation: arguments stack
+      into an input matrix and run through the tensor interpreter
+      (:198-227). Invalid results come back as NaN arrays (:169-186).
+    """
+
+    def __init__(self, tree: Node, operators: OperatorSet, nfeatures: int):
+        self.tree = tree
+        self.operators = operators
+        self.nfeatures = nfeatures
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"ComposableExpression({self.string()})"
+
+    def string(self, variable_names=None) -> str:
+        from ..ops.tree import string_tree
+
+        names = variable_names or [f"#{i + 1}" for i in range(self.nfeatures)]
+        return string_tree(self.tree, variable_names=names)
+
+    def __call__(self, *args):
+        if args and all(isinstance(a, ComposableExpression) for a in args):
+            return self._compose(args)
+        return self._evaluate(args)
+
+    def _compose(self, args: Sequence["ComposableExpression"]):
+        if len(args) < self.nfeatures:
+            raise ValueError(
+                f"Expression uses {self.nfeatures} arguments; got {len(args)}"
+            )
+
+        def substitute(n: Node) -> Node:
+            if n.degree == 0:
+                if (not n.constant) and (not n.is_parameter):
+                    return args[n.feature].tree.copy()
+                return n.copy()
+            return Node(
+                op=n.op, children=[substitute(c) for c in n.children]
+            )
+
+        nfeat = max((a.nfeatures for a in args), default=0)
+        return ComposableExpression(
+            substitute(self.tree), self.operators, nfeat
+        )
+
+    def _evaluate(self, args):
+        from ..ops.encoding import encode_population
+        from ..ops.eval import eval_tree_batch
+
+        scalar_input = args and all(np.ndim(getattr(a, "x", a)) == 0 for a in args)
+        vecs = []
+        valid_in = jnp.bool_(True)
+        n = 1
+        for a in args:
+            if _is_vv(a):
+                valid_in = valid_in & a.valid
+                v = jnp.atleast_1d(a.x)
+            else:
+                v = jnp.atleast_1d(jnp.asarray(a, jnp.float32))
+            vecs.append(v)
+            n = max(n, v.shape[0])
+        X = (
+            jnp.stack([jnp.broadcast_to(v, (n,)) for v in vecs])
+            if vecs
+            else jnp.zeros((1, 1), jnp.float32)
+        )
+        max_nodes = max(self.tree.count_nodes(), 1)
+        batch = encode_population([self.tree], max_nodes, self.operators,
+                                  dtype=np.asarray(X).dtype)
+        y, valid = eval_tree_batch(batch, X, self.operators)
+        y, valid = y[0], valid[0] & valid_in
+        if any(_is_vv(a) for a in args):
+            return ValidVector(y, valid)
+        y = jnp.where(valid, y, jnp.nan)
+        return float(y[0]) if scalar_input else y

@@ -11,13 +11,16 @@ parameter banks) and the eval dispatch.
   whose values form a per-member (max_parameters × num_classes) matrix,
   indexed by the dataset's ``class`` column
   (/root/reference/src/ParametricExpression.jl:35-51).
+- ``TemplateExpressionSpec``    — K named subexpressions combined by a
+  user structure function
+  (/root/reference/src/TemplateExpression.jl:1159-1187).
 """
 
 from __future__ import annotations
 
 import dataclasses
 
-__all__ = ["ExpressionSpec", "ParametricExpressionSpec"]
+__all__ = ["ExpressionSpec", "ParametricExpressionSpec", "TemplateExpressionSpec"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,3 +43,24 @@ class ParametricExpressionSpec(ExpressionSpec):
     def __post_init__(self):
         if self.max_parameters < 1:
             raise ValueError("max_parameters must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class TemplateExpressionSpec(ExpressionSpec):
+    """Spec for template expressions (TemplateExpressionSpec,
+    /root/reference/src/TemplateExpression.jl:1159-1187).
+
+    ``structure`` is a :class:`~..models.template.TemplateStructure` —
+    build it with :func:`~..models.template.template_spec` (decorator)
+    or :func:`~..models.template.make_template_structure`.
+    """
+
+    structure: "object" = None  # TemplateStructure (NamedTuple, hashable)
+
+    def __post_init__(self):
+        from .template import TemplateStructure
+
+        if not isinstance(self.structure, TemplateStructure):
+            raise ValueError(
+                "TemplateExpressionSpec requires structure=TemplateStructure"
+            )

@@ -1,0 +1,482 @@
+"""TemplateExpression — structured expressions with a user combiner.
+
+TPU re-design of /root/reference/src/TemplateExpression.jl and
+TemplateExpressionMacro.jl:
+
+- ``TemplateStructure`` (reference :106-160): K named subexpressions +
+  a ``combine`` function + optional named parameter vectors. The
+  combiner is an arbitrary *jnp-traceable* Python function over
+  ValidVectors (the reference allows arbitrary Julia closures; the TPU
+  API contract narrows this to traceable functions — SURVEY.md §7
+  "Template combiner generality").
+- Arity inference (reference :213-241): probe the combiner with
+  ``ArgumentRecorder``s that record how many arguments each
+  subexpression is called with.
+- ``template_spec`` (reference TemplateExpressionMacro.jl:34-151): the
+  Python analogue of ``@template_spec`` — a decorator that reads
+  subexpression / variable / parameter names off the function
+  signature.
+- Evaluation (reference :684-711): subexpressions become device
+  callables over postfix tensors; the combiner runs inside the jitted
+  eval with ValidVector validity algebra; the result must be a
+  ValidVector (else ``TemplateReturnError``).
+
+Population layout: a template member's trees are a ``TreeBatch`` with
+an extra leading key axis ``[K, L]``; its parameters are a flat bank
+``[total_params, 1]`` riding the same per-member parameter storage as
+parametric expressions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from types import SimpleNamespace
+from typing import Callable, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.encoding import TreeBatch, tree_structure_arrays
+from ..ops.eval import eval_single_tree
+from ..ops.operators import OperatorSet
+from .composable import ParamVec, ValidVector
+
+__all__ = [
+    "TemplateStructure",
+    "template_spec",
+    "TemplateReturnError",
+    "ArgumentRecorder",
+    "eval_template_single",
+    "eval_template_batch",
+    "HostTemplateExpression",
+]
+
+
+class TemplateReturnError(TypeError):
+    """Combiner returned something other than a ValidVector
+    (reference TemplateExpression.jl:634-666)."""
+
+    def __init__(self):
+        super().__init__(
+            "Template `combine` must return a ValidVector — use the "
+            "ValidVector algebra (subexpression calls and lifted "
+            "operators) all the way to the final result."
+        )
+
+
+class ArgumentRecorder:
+    """Stand-in subexpression that records call arity during inference
+    (reference TemplateExpression.jl:243-258)."""
+
+    def __init__(self, key: str, record: Dict[str, int]):
+        self._key = key
+        self._record = record
+
+    def __call__(self, *args):
+        prev = self._record.get(self._key, -1)
+        if prev == -1:
+            self._record[self._key] = len(args)
+        elif prev != len(args):
+            raise ValueError(
+                f"Inconsistent number of arguments passed to {self._key!r}: "
+                f"{prev} then {len(args)}"
+            )
+        if args:
+            a0 = args[0]
+            if isinstance(a0, ValidVector):
+                return a0
+            return ValidVector(jnp.atleast_1d(jnp.asarray(a0, jnp.float32)),
+                               jnp.bool_(True))
+        return ValidVector(jnp.ones((1,), jnp.float32), jnp.bool_(True))
+
+
+class TemplateStructure(NamedTuple):
+    """Static template configuration (hashable; lives inside the jitted
+    engine's static config). See reference TemplateExpression.jl:106-160.
+
+    ``combine(exprs, xs)`` or — with parameters — ``combine(exprs,
+    params, xs)``, where ``exprs``/``params`` are attribute namespaces
+    and ``xs`` is a tuple of per-feature ValidVectors.
+    """
+
+    combine: Callable
+    expr_keys: Tuple[str, ...]
+    num_features: Tuple[int, ...]       # per expr_key call arity
+    param_keys: Tuple[str, ...] = ()
+    num_params: Tuple[int, ...] = ()    # per param_key vector length
+    n_variables: int = 0                # dataset features consumed
+
+    @property
+    def has_params(self) -> bool:
+        return len(self.param_keys) > 0
+
+    @property
+    def total_params(self) -> int:
+        return int(sum(self.num_params))
+
+    @property
+    def n_subexpressions(self) -> int:
+        return len(self.expr_keys)
+
+    @property
+    def param_offsets(self) -> Tuple[int, ...]:
+        offs, o = [], 0
+        for n in self.num_params:
+            offs.append(o)
+            o += n
+        return tuple(offs)
+
+    def param_namespace(self, flat: jax.Array) -> SimpleNamespace:
+        """Views of a flat parameter bank as named ParamVecs."""
+        ns = {}
+        for k, off, n in zip(self.param_keys, self.param_offsets, self.num_params):
+            ns[k] = ParamVec(jax.lax.slice_in_dim(flat, off, off + n))
+        return SimpleNamespace(**ns)
+
+
+def make_template_structure(
+    combine: Callable,
+    *,
+    num_features: Optional[Dict[str, int]] = None,
+    num_parameters: Optional[Dict[str, int]] = None,
+    expressions: Optional[Sequence[str]] = None,
+    n_variables: Optional[int] = None,
+) -> TemplateStructure:
+    """Build a TemplateStructure from a reference-style combiner
+    ``combine(exprs, xs)`` / ``combine(exprs, params, xs)``.
+
+    ``num_features`` is inferred by probing when not given
+    (infer_variable_constraints, reference TemplateExpression.jl:213-241)
+    — which requires knowing how many variables to offer; pass
+    ``n_variables`` (or ``num_features`` explicitly) when the combiner
+    destructures the variable tuple.
+    """
+    num_parameters = dict(num_parameters or {})
+    if expressions is None:
+        if num_features is None:
+            raise ValueError(
+                "Pass `expressions=[...]` (subexpression names) or an "
+                "explicit `num_features` dict"
+            )
+        expressions = list(num_features)
+    expr_keys = tuple(expressions)
+    param_keys = tuple(num_parameters)
+    nparams = tuple(int(num_parameters[k]) for k in param_keys)
+
+    if num_features is None:
+        record: Dict[str, int] = {}
+        exprs = SimpleNamespace(
+            **{k: ArgumentRecorder(k, record) for k in expr_keys}
+        )
+        dummy_params = SimpleNamespace(
+            **{k: ParamVec(jnp.ones((n,), jnp.float32))
+               for k, n in zip(param_keys, nparams)}
+        )
+        tried = (
+            [n_variables] if n_variables is not None else list(range(1, 33))
+        )
+        last_err: Optional[Exception] = None
+        inferred_nv = None
+        for nv in tried:
+            record.clear()
+            xs = tuple(
+                ValidVector(jnp.ones((1,), jnp.float32), jnp.bool_(True))
+                for _ in range(nv)
+            )
+            try:
+                if param_keys:
+                    out = combine(exprs, dummy_params, xs)
+                else:
+                    out = combine(exprs, xs)
+            except (TypeError, ValueError, IndexError) as e:  # try next count
+                last_err = e
+                continue
+            if not isinstance(out, ValidVector):
+                raise TemplateReturnError()
+            inferred_nv = nv
+            break
+        if inferred_nv is None:
+            raise ValueError(
+                f"Could not infer the combiner's variable count; "
+                f"last error: {last_err!r}"
+            )
+        missing = [k for k in expr_keys if k not in record]
+        if missing:
+            raise ValueError(
+                f"Failed to infer number of features used by {missing} — "
+                "the combiner never called them (reference "
+                "TemplateExpression.jl:235-240)"
+            )
+        num_features = {k: record[k] for k in expr_keys}
+        n_variables = inferred_nv
+    else:
+        if n_variables is None:
+            raise ValueError(
+                "Pass `n_variables` along with explicit `num_features`"
+            )
+
+    return TemplateStructure(
+        combine=combine,
+        expr_keys=expr_keys,
+        num_features=tuple(int(num_features[k]) for k in expr_keys),
+        param_keys=param_keys,
+        num_params=nparams,
+        n_variables=int(n_variables),
+    )
+
+
+def template_spec(
+    *,
+    expressions: Sequence[str],
+    parameters: Optional[Dict[str, int]] = None,
+):
+    """Decorator analogue of ``@template_spec``
+    (reference TemplateExpressionMacro.jl:34-151).
+
+    The decorated function's signature names, in order: the
+    subexpressions, then the dataset variables, then the parameter
+    vectors::
+
+        @template_spec(expressions=("f", "g"), parameters={"p": 2})
+        def structure(f, g, x1, x2, x3, p):
+            return f(x1, x2) + g(x3) ** 2 * p[0] + p[1]
+
+    Returns a :class:`~symbolicregression_jl_tpu.models.spec.TemplateExpressionSpec`.
+    """
+    parameters = dict(parameters or {})
+    expr_keys = tuple(expressions)
+    param_keys = tuple(parameters)
+
+    def build(fn: Callable):
+        sig_names = list(inspect.signature(fn).parameters)
+        for k in expr_keys:
+            if k not in sig_names:
+                raise ValueError(
+                    f"Subexpression {k!r} not in function signature {sig_names}"
+                )
+        for k in param_keys:
+            if k not in sig_names:
+                raise ValueError(
+                    f"Parameter {k!r} not in function signature {sig_names}"
+                )
+        var_names = [
+            n for n in sig_names if n not in expr_keys and n not in param_keys
+        ]
+
+        def combine(exprs, *rest):
+            if param_keys:
+                params, xs = rest
+            else:
+                (xs,) = rest
+                params = None
+            kw = {k: getattr(exprs, k) for k in expr_keys}
+            if len(xs) != len(var_names):
+                raise ValueError(
+                    f"Template expects {len(var_names)} variables "
+                    f"({var_names}); dataset provides {len(xs)}"
+                )
+            kw.update(dict(zip(var_names, xs)))
+            if params is not None:
+                kw.update({k: getattr(params, k) for k in param_keys})
+            return fn(**kw)
+
+        structure = make_template_structure(
+            combine,
+            num_parameters=parameters,
+            expressions=expr_keys,
+            n_variables=len(var_names),
+        )
+        from .spec import TemplateExpressionSpec
+
+        return TemplateExpressionSpec(structure=structure)
+
+    return build
+
+
+# ---------------------------------------------------------------------------
+# Device-side evaluation
+# ---------------------------------------------------------------------------
+
+
+class _TreeCallable:
+    """Device callable over one subexpression's postfix tensors
+    (the jitted analogue of calling a ComposableExpression,
+    reference ComposableExpression.jl:198-227)."""
+
+    def __init__(self, key, fields, child, arity_expected: int, operators, n: int):
+        self.key = key
+        self.fields = fields  # (arity, op, feat, const, length) — [L] each
+        self.child = child
+        self.arity_expected = arity_expected
+        self.operators = operators
+        self.n = n
+
+    def __call__(self, *args):
+        if len(args) != self.arity_expected:
+            raise ValueError(
+                f"Subexpression {self.key!r} takes {self.arity_expected} "
+                f"arguments; got {len(args)}"
+            )
+        valid_in = jnp.bool_(True)
+        rows = []
+        for a in args:
+            if isinstance(a, ValidVector):
+                valid_in = valid_in & a.valid
+                rows.append(jnp.broadcast_to(jnp.atleast_1d(a.x), (self.n,)))
+            else:
+                rows.append(
+                    jnp.broadcast_to(jnp.asarray(a, self.fields[3].dtype),
+                                     (self.n,))
+                )
+        Xk = (
+            jnp.stack(rows)
+            if rows
+            else jnp.zeros((1, self.n), self.fields[3].dtype)
+        )
+        arity, op, feat, const, length = self.fields
+        y, v = eval_single_tree(
+            arity, op, feat, const, length, self.child, Xk, self.operators
+        )
+        return ValidVector(y, v & valid_in)
+
+
+def eval_template_single(
+    trees: TreeBatch,            # [K, L]
+    X: jax.Array,                # [F, n]
+    structure: TemplateStructure,
+    operators: OperatorSet,
+    params_flat: Optional[jax.Array] = None,   # [total_params]
+) -> Tuple[jax.Array, jax.Array]:
+    """Evaluate one template member over all rows; returns (y[n], valid).
+
+    Mirrors DE.eval_tree_array for TemplateExpression (reference
+    :684-711): wrap dataset rows in ValidVectors, hand the combiner
+    device callables for the subexpressions, demand a ValidVector back.
+    """
+    n = X.shape[1]
+    child, _, _ = tree_structure_arrays(trees, need_depth=False)  # [K, L, A]
+    exprs = {}
+    for k, key in enumerate(structure.expr_keys):
+        fields = (
+            trees.arity[k], trees.op[k], trees.feat[k], trees.const[k],
+            trees.length[k],
+        )
+        exprs[key] = _TreeCallable(
+            key, fields, child[k], structure.num_features[k], operators, n
+        )
+    xs = tuple(
+        ValidVector(X[i], jnp.bool_(True)) for i in range(structure.n_variables)
+    )
+    if structure.has_params:
+        if params_flat is None:
+            raise ValueError("Template has parameters but none were provided")
+        pns = structure.param_namespace(params_flat)
+        out = structure.combine(SimpleNamespace(**exprs), pns, xs)
+    else:
+        out = structure.combine(SimpleNamespace(**exprs), xs)
+    if not isinstance(out, ValidVector):
+        raise TemplateReturnError()
+    y = jnp.broadcast_to(jnp.atleast_1d(out.x), (n,))
+    valid = out.valid & jnp.all(jnp.isfinite(y))
+    return y, valid
+
+
+def eval_template_batch(
+    trees: TreeBatch,            # [..., K, L]
+    X: jax.Array,                # [F, n]
+    structure: TemplateStructure,
+    operators: OperatorSet,
+    params: Optional[jax.Array] = None,   # [..., total_params]
+) -> Tuple[jax.Array, jax.Array]:
+    """Batched template evaluation; returns (y[..., n], valid[...])."""
+    K = structure.n_subexpressions
+    L = trees.max_nodes
+    batch_shape = trees.arity.shape[:-2]
+    flat = trees.reshape(-1, K)
+    T = structure.total_params
+    if T > 0:
+        p_flat = params.reshape(-1, T)
+    else:
+        p_flat = jnp.zeros((int(np.prod(batch_shape)) if batch_shape else 1, 0),
+                           trees.const.dtype)
+    y, valid = jax.vmap(
+        lambda t, p: eval_template_single(t, X, structure, operators, p)
+    )(flat, p_flat)
+    return y.reshape(*batch_shape, X.shape[1]), valid.reshape(batch_shape)
+
+
+# ---------------------------------------------------------------------------
+# Host-side expression (printing / export / prediction bookkeeping)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class HostTemplateExpression:
+    """Decoded template member: named host subtrees + parameter values.
+
+    The printing format mirrors the reference's multi-component string
+    (reference TemplateExpression.jl:594-630): subexpression arguments
+    display as ``#1..#k``, components join with ``; ``.
+    """
+
+    trees: Dict[str, "object"]          # key -> ops.tree.Node
+    structure: TemplateStructure
+    operators: OperatorSet
+    params: Optional[np.ndarray] = None  # [total_params]
+
+    def string(self, pretty: bool = False, precision: int = 5) -> str:
+        from ..ops.tree import string_tree
+
+        parts = []
+        for k, key in enumerate(self.structure.expr_keys):
+            names = [f"#{i + 1}" for i in range(self.structure.num_features[k])]
+            s = string_tree(self.trees[key], variable_names=names,
+                            precision=precision)
+            parts.append(f"{key} = {s}")
+        if self.structure.has_params and self.params is not None:
+            for key, off, cnt in zip(
+                self.structure.param_keys,
+                self.structure.param_offsets,
+                self.structure.num_params,
+            ):
+                vals = ", ".join(
+                    f"{float(v):.{precision}g}"
+                    for v in self.params[off:off + cnt]
+                )
+                parts.append(f"{key} = [{vals}]")
+        sep = "\n" if pretty else "; "
+        return sep.join(parts)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"HostTemplateExpression({self.string()})"
+
+    def __call__(self, X: np.ndarray) -> np.ndarray:
+        """Evaluate on host data X [n, F]; invalid => NaN
+        (prediction semantics, reference ComposableExpression.jl:169-186)."""
+        from ..ops.encoding import encode_population
+
+        Xt = jnp.asarray(np.asarray(X).T)
+        L = max(
+            max(t.count_nodes() for t in self.trees.values()), 1
+        )
+        enc = encode_population(
+            [self.trees[k] for k in self.structure.expr_keys], L, self.operators
+        )
+        stacked = TreeBatch(
+            arity=enc.arity[None], op=enc.op[None], feat=enc.feat[None],
+            const=enc.const[None], length=enc.length[None],
+        )  # [1, K, L]
+        p = (
+            jnp.asarray(self.params, enc.const.dtype)[None]
+            if self.params is not None and self.structure.total_params
+            else None
+        )
+        y, valid = eval_template_batch(
+            stacked, Xt, self.structure, self.operators, p
+        )
+        y = np.asarray(y[0])
+        if not bool(valid[0]):
+            return np.full_like(y, np.nan)
+        return y

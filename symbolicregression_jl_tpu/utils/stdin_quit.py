@@ -1,0 +1,96 @@
+"""Interactive quit: watch stdin for 'q' (or ctrl-d) during a search.
+
+TPU analogue of the reference's StdinReader/watch_stream/
+check_for_user_quit (/root/reference/src/SearchUtils.jl:336-385): a
+daemon thread reads the input stream; the host loop polls ``quit`` once
+per early-stop check and ends the search gracefully, keeping all results
+produced so far.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import weakref
+from typing import Optional, TextIO
+
+__all__ = ["StdinQuitWatcher"]
+
+
+def _watch_loop(watcher_ref) -> None:
+    """Thread body holding only a weakref: when the owning search frame
+    dies (return OR exception), the watcher is collected and the thread
+    exits at the next poll — no stdin-consuming thread can outlive its
+    search."""
+    while True:
+        w = watcher_ref()
+        if w is None or w.quit or w._stopped:
+            return
+        try:
+            if not w._readable(0.2):
+                continue
+            ch = w.stream.read(1)
+            if w._stopped:
+                return
+            if ch == "" or ch.lower() == "q":  # EOF (ctrl-d) or quit
+                w.quit = True
+                return
+        except (ValueError, OSError):  # stream closed mid-search
+            return
+        finally:
+            del w  # don't pin the watcher across the poll sleep
+
+
+class StdinQuitWatcher:
+    """Reads characters off ``stream`` on a daemon thread; sets ``quit``
+    when a 'q' (or end-of-stream ctrl-d) arrives.
+
+    Only engages when the stream is an interactive TTY (tests and batch
+    jobs are unaffected) unless ``force=True`` (used with injected
+    streams in tests).
+    """
+
+    def __init__(self, stream: Optional[TextIO] = None, force: bool = False):
+        self.stream = stream if stream is not None else sys.stdin
+        self.quit = False
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        try:
+            interactive = force or self.stream.isatty()
+        except (AttributeError, ValueError):
+            interactive = False
+        self.active = bool(interactive)
+        if self.active:
+            self._thread = threading.Thread(
+                target=_watch_loop, args=(weakref.ref(self),), daemon=True
+            )
+            self._thread.start()
+
+    def _readable(self, timeout: float) -> bool:
+        """Poll the stream for input so the thread can exit on stop();
+        streams without a selectable fd (StringIO) are always readable."""
+        import select
+
+        try:
+            fd = self.stream.fileno()
+        except (AttributeError, OSError, ValueError):
+            return True
+        try:
+            r, _, _ = select.select([fd], [], [], timeout)
+        except (OSError, ValueError):
+            return False
+        return bool(r)
+
+    def stop(self) -> None:
+        """End the watcher thread (called when the search finishes —
+        otherwise a stale thread would keep consuming stdin characters
+        meant for a later search)."""
+        self._stopped = True
+
+    def __del__(self):  # backstop for exception paths
+        self._stopped = True
+
+    def check(self) -> bool:
+        """True when the user asked to quit (check_for_user_quit,
+        src/SearchUtils.jl:372-377)."""
+        return self.active and self.quit

@@ -1,0 +1,174 @@
+"""On-disk checkpoint/resume of the full search state.
+
+Kill-and-resume contract (the cross-process analogue of the reference's
+saved-output reload, /root/reference/src/SymbolicRegression.jl:760-821):
+a search writes `search_state.pkl` next to the hall-of-fame CSVs; a
+*fresh* `equation_search(..., saved_state=<path>)` continues it, and an
+incompatible option change errors out before touching the state.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.api.checkpoint import (
+    load_search_state,
+    save_search_state,
+)
+from symbolicregression_jl_tpu.api.search import RuntimeOptions
+
+
+def _problem(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, (n, 2)).astype(np.float32)
+    y = (2.0 * X[:, 0] + X[:, 1] * X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options(tmp_path, **kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=[],
+        maxsize=10,
+        populations=2,
+        population_size=12,
+        tournament_selection_n=4,
+        ncycles_per_iteration=4,
+        save_to_file=True,
+        output_directory=str(tmp_path),
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def test_checkpoint_write_and_resume(tmp_path):
+    X, y = _problem()
+    options = _options(tmp_path)
+    ropt = RuntimeOptions(niterations=2, run_id="ckpt_run", seed=0, verbosity=0)
+    hof1 = equation_search(X, y, options=options, runtime_options=ropt)
+    ckpt = os.path.join(str(tmp_path), "ckpt_run", "search_state.pkl")
+    assert os.path.exists(ckpt)
+    best1 = min(e.loss for e in hof1.entries)
+
+    # Resume from disk with fresh Options (same config) — simulates a new
+    # process; the search continues rather than restarting.
+    options2 = _options(tmp_path)
+    ropt2 = RuntimeOptions(niterations=2, run_id="ckpt_run2", seed=1, verbosity=0)
+    hof2 = equation_search(
+        X, y, options=options2, saved_state=ckpt, runtime_options=ropt2
+    )
+    best2 = min(e.loss for e in hof2.entries)
+    assert best2 <= best1 + 1e-6, "resume lost progress"
+
+
+def test_checkpoint_incompatible_options_raise(tmp_path):
+    X, y = _problem()
+    options = _options(tmp_path)
+    ropt = RuntimeOptions(niterations=1, run_id="ckpt_bad", seed=0, verbosity=0)
+    equation_search(X, y, options=options, runtime_options=ropt)
+    ckpt = os.path.join(str(tmp_path), "ckpt_bad", "search_state.pkl")
+
+    with pytest.raises(ValueError, match="maxsize"):
+        equation_search(
+            X, y, options=_options(tmp_path, maxsize=16), saved_state=ckpt,
+            runtime_options=RuntimeOptions(niterations=1, verbosity=0),
+        )
+    with pytest.raises(ValueError, match="operators"):
+        equation_search(
+            X, y,
+            options=_options(tmp_path, binary_operators=["+", "*", "/"]),
+            saved_state=ckpt,
+            runtime_options=RuntimeOptions(niterations=1, verbosity=0),
+        )
+
+
+def test_save_load_roundtrip_preserves_state(tmp_path):
+    X, y = _problem()
+    options = _options(tmp_path, save_to_file=False)
+    state, _ = equation_search(
+        X, y, options=options,
+        runtime_options=RuntimeOptions(niterations=1, seed=3, verbosity=0,
+                                       return_state=True),
+    )
+    p = str(tmp_path / "state.pkl")
+    save_search_state(p, state)
+    loaded = load_search_state(p, options)
+    assert loaded.num_evals == pytest.approx(state.num_evals)
+    ds0, ld0 = state.device_states[0], loaded.device_states[0]
+    np.testing.assert_array_equal(
+        np.asarray(ds0.pops.trees.arity), np.asarray(ld0.pops.trees.arity)
+    )
+    np.testing.assert_allclose(
+        np.asarray(ds0.pops.cost), np.asarray(ld0.pops.cost), rtol=1e-6
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ds0.hof.exists), np.asarray(ld0.hof.exists)
+    )
+
+
+def test_resume_num_evals_not_double_counted(tmp_path):
+    # fresh 2-iteration run vs (1 iteration -> resume -> 1 iteration):
+    # identical seed => identical totals; double-counting would inflate
+    # the resumed total by the first run's evals.
+    X, y = _problem()
+    options = _options(tmp_path, save_to_file=False)
+    from symbolicregression_jl_tpu import equation_search as es
+
+    s2, _ = es(X, y, options=options,
+               runtime_options=RuntimeOptions(niterations=2, seed=5,
+                                              verbosity=0, return_state=True))
+    s1, _ = es(X, y, options=options,
+               runtime_options=RuntimeOptions(niterations=1, seed=5,
+                                              verbosity=0, return_state=True))
+    sr, _ = es(X, y, options=options, saved_state=s1,
+               runtime_options=RuntimeOptions(niterations=1, seed=5,
+                                              verbosity=0, return_state=True))
+    assert sr.num_evals == pytest.approx(s2.num_evals, rel=1e-6)
+
+
+def test_resume_rejects_different_feature_count(tmp_path):
+    X, y = _problem()
+    options = _options(tmp_path, save_to_file=False)
+    from symbolicregression_jl_tpu import equation_search as es
+
+    s1, _ = es(X, y, options=options,
+               runtime_options=RuntimeOptions(niterations=1, seed=0,
+                                              verbosity=0, return_state=True))
+    X3 = np.concatenate([X, X[:, :1]], axis=1)  # 3 features
+    with pytest.raises(ValueError, match="features"):
+        es(X3, y, options=options, saved_state=s1,
+           runtime_options=RuntimeOptions(niterations=1, verbosity=0))
+
+
+def test_checkpoint_written_on_early_stop(tmp_path):
+    # early_stop_condition fires after iteration 1 (checkpoint_every_n=5
+    # would otherwise skip it) — the final write must still happen.
+    X, y = _problem()
+    options = _options(tmp_path, early_stop_condition=1e9)
+    ropt = RuntimeOptions(niterations=7, run_id="ckpt_es", seed=0,
+                          verbosity=0, checkpoint_every_n=5)
+    equation_search(X, y, options=options, runtime_options=ropt)
+    ckpt = os.path.join(str(tmp_path), "ckpt_es", "search_state.pkl")
+    assert os.path.exists(ckpt)
+    from symbolicregression_jl_tpu.api.checkpoint import load_search_state
+
+    st = load_search_state(ckpt, _options(tmp_path, early_stop_condition=1e9))
+    assert st.num_evals > 0
+
+
+def test_multioutput_tuple_guesses_not_misnested(tmp_path):
+    # A flat list of (expr, params) pair guesses on a 2-output search must
+    # seed BOTH outputs with both guesses, not be split per output.
+    X, y = _problem()
+    Y = np.stack([y, -y], axis=0)  # equation_search takes [nout, n]
+    options = _options(tmp_path, save_to_file=False)
+    hofs = equation_search(
+        X, Y, options=options,
+        guesses=[("x1 + x2", None), ("x1 * x2", None)],
+        runtime_options=RuntimeOptions(niterations=1, seed=0, verbosity=0),
+    )
+    assert len(hofs) == 2
+    for h in hofs:
+        assert len(h.entries) > 0

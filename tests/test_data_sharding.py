@@ -1,0 +1,93 @@
+"""Data-axis sharding (n_data_shards > 1): dataset rows sharded over the
+mesh's data axis with the loss reduction as a cross-shard psum.
+
+The fused Pallas kernel path is documented to fall back to the jnp
+interpreter under row sharding (evolve/step.py
+evolve_config_from_options); these tests exercise the full search on
+4x2 and 2x4 virtual meshes (conftest provisions 8 CPU devices).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.api.search import RuntimeOptions
+from symbolicregression_jl_tpu.core.dataset import make_dataset
+from symbolicregression_jl_tpu.parallel.mesh import (
+    DATA_AXIS,
+    make_mesh,
+    shard_device_data,
+)
+
+
+def _problem(n=256):
+    rng = np.random.default_rng(5)
+    X = rng.uniform(-2, 2, (n, 3)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2]).astype(np.float32)
+    return X, y
+
+
+def test_shard_device_data_places_rows_on_data_axis():
+    assert len(jax.devices()) == 8, "conftest virtual mesh not engaged"
+    mesh = make_mesh(jax.devices(), n_island_shards=4, n_data_shards=2)
+    X, y = _problem()
+    ds = make_dataset(X, y)
+    data = shard_device_data(ds.data, mesh)
+    spec = data.Xt.sharding.spec
+    assert spec[1] == DATA_AXIS  # rows sharded
+    assert data.y.sharding.spec[0] == DATA_AXIS
+
+
+@pytest.mark.parametrize("n_data_shards", [2, 4])
+def test_search_with_data_sharding(n_data_shards):
+    X, y = _problem()
+    options = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=4,
+        population_size=12,
+        tournament_selection_n=4,
+        ncycles_per_iteration=4,
+        save_to_file=False,
+    )
+    hof = equation_search(
+        X, y, options=options,
+        runtime_options=RuntimeOptions(
+            niterations=3, seed=0, verbosity=0, n_data_shards=n_data_shards
+        ),
+    )
+    best = min(e.loss for e in hof.entries)
+    assert np.isfinite(best)
+    assert best < 2.0  # search made real progress under row sharding
+
+
+def test_sharded_matches_unsharded_loss():
+    # Same seed, 1 vs 2 data shards: losses must agree (the psum
+    # reduction is exact up to float reassociation).
+    X, y = _problem(128)
+    options = Options(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=6,
+        populations=2,
+        population_size=10,
+        tournament_selection_n=4,
+        ncycles_per_iteration=2,
+        save_to_file=False,
+    )
+    losses = []
+    for shards in (1, 2):
+        hof = equation_search(
+            X, y, options=options,
+            runtime_options=RuntimeOptions(
+                niterations=2, seed=9, verbosity=0, n_data_shards=shards
+            ),
+        )
+        losses.append(sorted((e.complexity, e.loss) for e in hof.entries))
+    a, b = losses
+    assert [c for c, _ in a] == [c for c, _ in b]
+    for (_, la), (_, lb) in zip(a, b):
+        np.testing.assert_allclose(la, lb, rtol=1e-4)

@@ -1,0 +1,55 @@
+"""Deterministic mode (test_deterministic.jl analogue, SURVEY.md §4):
+deterministic=True requires a seed, and two seeded runs produce identical
+halls of fame.
+"""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+
+
+def _problem(n=150):
+    rng = np.random.default_rng(7)
+    X = rng.uniform(-2, 2, (n, 2)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 1.5).astype(np.float32)
+    return X, y
+
+
+def _options(**kw):
+    base = dict(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=12,
+        tournament_selection_n=4,
+        ncycles_per_iteration=4,
+        save_to_file=False,
+        deterministic=True,
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def test_deterministic_requires_seed():
+    X, y = _problem()
+    with pytest.raises(ValueError, match="seed"):
+        equation_search(X, y, options=_options(), niterations=1, verbosity=0)
+
+
+def test_two_deterministic_runs_identical():
+    X, y = _problem()
+    hofs = []
+    for _ in range(2):
+        hofs.append(
+            equation_search(
+                X, y, options=_options(seed=11), niterations=3, verbosity=0
+            )
+        )
+    a, b = hofs
+    assert len(a.entries) == len(b.entries)
+    for ea, eb in zip(a.entries, b.entries):
+        assert ea.complexity == eb.complexity
+        assert ea.loss == eb.loss
+        assert ea.equation_string() == eb.equation_string()

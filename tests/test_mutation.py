@@ -1,0 +1,351 @@
+"""Direct property tests for the structural mutation primitives.
+
+Mirrors the reference's evolution-core suite (SURVEY.md §4:
+test_rotation.jl, test_crossover.jl, test_feature_mutation.jl, ...):
+every mutation output must be a valid postfix encoding (decode ->
+re-encode round trip), rotate preserves node count
+(/root/reference/src/MutationFunctions.jl:594-633), delete removes the
+node and its non-carried children (:336-356), insert/append respect the
+slot budget, and value mutations touch only their own fields.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from symbolicregression_jl_tpu.evolve.mutation import (
+    MutationContext,
+    add_node,
+    branch_nu,
+    crossover_trees,
+    delete_node,
+    gen_random_tree_fixed_size,
+    gen_tree_nu,
+    insert_random_op,
+    mutate_constant,
+    mutate_feature,
+    mutate_operator,
+    randomize_tree,
+    rotate_tree,
+    swap_operands,
+)
+from symbolicregression_jl_tpu.ops.encoding import (
+    LEAF_CONST,
+    LEAF_VAR,
+    TreeBatch,
+    decode_tree,
+    encode_tree,
+)
+from symbolicregression_jl_tpu.ops.operators import OperatorSet
+
+L = 15
+NFEAT = 3
+
+
+@pytest.fixture(scope="module")
+def ops():
+    return OperatorSet(
+        binary_operators=["+", "-", "*", "/"], unary_operators=["cos", "exp"]
+    )
+
+
+@pytest.fixture(scope="module")
+def ctx(ops):
+    return MutationContext(
+        nops=ops.nops_tuple(),
+        nfeatures=NFEAT,
+        max_nodes=L,
+        perturbation_factor=0.076,
+        probability_negate_constant=0.01,
+    )
+
+
+def _random_trees(ctx, n, seed=0, min_size=3):
+    """n random single trees ([L] TreeBatch each) of assorted sizes."""
+    out = []
+    key = jax.random.key(seed)
+    i = 0
+    while len(out) < n:
+        key, k1, k2 = jax.random.split(key, 3)
+        size = int(jax.random.randint(k1, (), min_size, L))
+        t = gen_random_tree_fixed_size(k2, size, ctx, jnp.float32)
+        out.append(t)
+        i += 1
+    return out
+
+
+def _assert_valid_postfix(tree, ops, what):
+    """Round-trip decode -> re-encode must reproduce the used slots."""
+    arity = np.asarray(tree.arity)
+    op = np.asarray(tree.op)
+    feat = np.asarray(tree.feat)
+    const = np.asarray(tree.const)
+    length = int(tree.length)
+    assert 1 <= length <= L, f"{what}: length {length} out of range"
+    node = decode_tree(arity, op, feat, const, length, ops)  # raises if malformed
+    re_a, re_o, re_f, re_c, re_len = encode_tree(node, L, ops)
+    assert re_len == length, f"{what}: re-encode length mismatch"
+    np.testing.assert_array_equal(re_a[:length], arity[:length], err_msg=what)
+    np.testing.assert_array_equal(re_o[:length], op[:length], err_msg=what)
+    np.testing.assert_array_equal(re_f[:length], feat[:length], err_msg=what)
+    np.testing.assert_allclose(re_c[:length], const[:length], err_msg=what)
+    return node
+
+
+def _u(budget, seed):
+    return jax.random.uniform(jax.random.key(seed), (budget,))
+
+
+N_TRIALS = 25
+
+
+def test_rotate_preserves_node_multiset(ctx, ops):
+    budget = branch_nu(ctx)["rotate_tree"]
+    for i, t in enumerate(_random_trees(ctx, N_TRIALS, seed=1)):
+        new, ok = rotate_tree(_u(budget, i), t, ctx)
+        assert bool(ok), f"trial {i}"
+        _assert_valid_postfix(new, ops, f"rotate {i}")
+        # rotation permutes spans: node count and the multiset of
+        # (arity, op, feat, const) rows are both preserved
+        assert int(new.length) == int(t.length)
+        old_rows = sorted(
+            (int(a), int(o), int(f), round(float(c), 5))
+            for a, o, f, c in zip(
+                np.asarray(t.arity)[: int(t.length)],
+                np.asarray(t.op)[: int(t.length)],
+                np.asarray(t.feat)[: int(t.length)],
+                np.asarray(t.const)[: int(t.length)],
+            )
+        )
+        new_rows = sorted(
+            (int(a), int(o), int(f), round(float(c), 5))
+            for a, o, f, c in zip(
+                np.asarray(new.arity)[: int(new.length)],
+                np.asarray(new.op)[: int(new.length)],
+                np.asarray(new.feat)[: int(new.length)],
+                np.asarray(new.const)[: int(new.length)],
+            )
+        )
+        assert old_rows == new_rows, f"trial {i}"
+
+
+def test_swap_operands_preserves_count_and_plus_semantics(ops):
+    # Commutative root: swapping operands must not change the value.
+    plus_ops = OperatorSet(binary_operators=["+"], unary_operators=["cos"])
+    ctx2 = MutationContext(
+        nops=plus_ops.nops_tuple(), nfeatures=NFEAT, max_nodes=L,
+        perturbation_factor=0.076, probability_negate_constant=0.01,
+    )
+    from symbolicregression_jl_tpu.ops.eval import eval_tree_batch
+
+    X = jnp.asarray(
+        np.random.default_rng(0).normal(size=(NFEAT, 16)).astype(np.float32)
+    )
+    for i, t in enumerate(_random_trees(ctx2, N_TRIALS, seed=2)):
+        new, ok = swap_operands(_u(ctx2.max_nodes, i), t, ctx2)
+        assert bool(ok)
+        _assert_valid_postfix(new, plus_ops, f"swap {i}")
+        assert int(new.length) == int(t.length)
+        batched = jax.tree.map(lambda a, b: jnp.stack([jnp.asarray(a), jnp.asarray(b)]), t, new)
+        y, valid = eval_tree_batch(batched, X, plus_ops)
+        np.testing.assert_allclose(
+            np.asarray(y[0]), np.asarray(y[1]), rtol=1e-5, atol=1e-5
+        )
+
+
+def test_delete_removes_op_and_non_carried_children(ctx, ops):
+    budget = branch_nu(ctx)["delete_node"]
+    shrunk = 0
+    for i, t in enumerate(_random_trees(ctx, N_TRIALS, seed=3)):
+        has_op = bool(np.any(np.asarray(t.arity)[: int(t.length)] > 0))
+        new, ok = delete_node(_u(budget, i), t, ctx)
+        assert bool(ok)
+        _assert_valid_postfix(new, ops, f"delete {i}")
+        if has_op:
+            assert int(new.length) < int(t.length)
+            # op count drops by >= 1 (the deleted node, plus any ops in
+            # dropped sibling spans)
+            n_ops_old = int(np.sum(np.asarray(t.arity)[: int(t.length)] > 0))
+            n_ops_new = int(np.sum(np.asarray(new.arity)[: int(new.length)] > 0))
+            assert n_ops_new <= n_ops_old - 1
+            shrunk += 1
+    assert shrunk > 0
+
+
+def test_delete_on_unary_chain_removes_exactly_one(ops):
+    # cos(cos(x1)): deleting either op removes exactly one node.
+    un_ops = OperatorSet(binary_operators=[], unary_operators=["cos"])
+    ctxu = MutationContext(
+        nops=un_ops.nops_tuple(), nfeatures=1, max_nodes=L,
+        perturbation_factor=0.076, probability_negate_constant=0.01,
+    )
+    from symbolicregression_jl_tpu.ops.tree import parse_expression
+    from symbolicregression_jl_tpu.ops.encoding import encode_population
+
+    t = encode_population(
+        [parse_expression("cos(cos(x1))", un_ops)], L, un_ops
+    )[0]
+    budget = branch_nu(ctxu)["delete_node"]
+    for i in range(8):
+        new, ok = delete_node(_u(budget, 100 + i), t, ctxu)
+        assert bool(ok)
+        assert int(new.length) == int(t.length) - 1
+        _assert_valid_postfix(new, un_ops, f"unary delete {i}")
+
+
+def test_insert_and_add_respect_slot_budget(ctx, ops):
+    bi = branch_nu(ctx)["insert_node"]
+    ba = branch_nu(ctx)["add_node"]
+    grew = 0
+    for i, t in enumerate(_random_trees(ctx, N_TRIALS, seed=4)):
+        for name, fn, budget in (
+            ("insert", insert_random_op, bi),
+            ("add", add_node, ba),
+        ):
+            new, ok = fn(_u(budget, 10 * i + len(name)), t, ctx)
+            # ok=False marks the attempt as failed — the generation step
+            # discards it (first-valid selection), so only ok=True
+            # results must be valid trees.
+            if bool(ok):
+                _assert_valid_postfix(new, ops, f"{name} {i}")
+                assert int(new.length) <= L
+                if int(new.length) > int(t.length):
+                    grew += 1
+    assert grew > 0
+
+
+def test_insert_overflow_rejected(ctx, ops):
+    # A tree already at the slot limit cannot grow: ok must be False.
+    budget = branch_nu(ctx)["insert_node"]
+    key = jax.random.key(7)
+    t = gen_random_tree_fixed_size(key, L, ctx, jnp.float32)
+    if int(t.length) < L - 1:
+        pytest.skip("generator did not fill the slots")
+    hit_reject = False
+    for i in range(10):
+        new, ok = insert_random_op(_u(budget, 200 + i), t, ctx)
+        if not bool(ok):
+            hit_reject = True
+        else:
+            # accepted results must still fit the slot budget
+            assert int(new.length) <= L
+    assert hit_reject
+
+
+def test_crossover_produces_valid_children(ctx, ops):
+    trees = _random_trees(ctx, 2 * N_TRIALS, seed=5)
+    budget = 2 * ctx.max_nodes
+    exchanged = 0
+    for i in range(N_TRIALS):
+        t1, t2 = trees[2 * i], trees[2 * i + 1]
+        c1, c2, ok1, ok2 = crossover_trees(_u(budget, i), t1, t2, ctx)
+        if bool(ok1):
+            _assert_valid_postfix(c1, ops, f"xover child1 {i}")
+            assert int(c1.length) <= L
+        if bool(ok2):
+            _assert_valid_postfix(c2, ops, f"xover child2 {i}")
+            assert int(c2.length) <= L
+        if bool(ok1) and int(c1.length) != int(t1.length):
+            exchanged += 1
+    assert exchanged > 0, "crossover never exchanged different-size subtrees"
+
+
+def test_mutate_constant_touches_only_constants(ctx, ops):
+    budget = branch_nu(ctx)["mutate_constant"]
+    changed = 0
+    for i, t in enumerate(_random_trees(ctx, N_TRIALS, seed=6)):
+        new, ok = mutate_constant(_u(budget, i), t, jnp.float32(1.0), ctx)
+        assert bool(ok)
+        np.testing.assert_array_equal(np.asarray(new.arity), np.asarray(t.arity))
+        np.testing.assert_array_equal(np.asarray(new.op), np.asarray(t.op))
+        np.testing.assert_array_equal(np.asarray(new.feat), np.asarray(t.feat))
+        assert int(new.length) == int(t.length)
+        diff = np.asarray(new.const) != np.asarray(t.const)
+        has_const = np.any(
+            (np.asarray(t.arity)[: int(t.length)] == 0)
+            & (np.asarray(t.op)[: int(t.length)] == LEAF_CONST)
+        )
+        if has_const and np.any(diff):
+            # exactly one slot, and it is a constant leaf
+            assert np.sum(diff) == 1
+            k = int(np.argmax(diff))
+            assert np.asarray(t.arity)[k] == 0
+            assert np.asarray(t.op)[k] == LEAF_CONST
+            changed += 1
+    assert changed > 0
+
+
+def test_mutate_operator_changes_one_op_same_arity(ctx, ops):
+    budget = branch_nu(ctx)["mutate_operator"]
+    for i, t in enumerate(_random_trees(ctx, N_TRIALS, seed=7)):
+        new, ok = mutate_operator(_u(budget, i), t, ctx)
+        assert bool(ok)
+        _assert_valid_postfix(new, ops, f"mutate_operator {i}")
+        np.testing.assert_array_equal(np.asarray(new.arity), np.asarray(t.arity))
+        diff = np.asarray(new.op) != np.asarray(t.op)
+        assert np.sum(diff) <= 1
+        if np.any(diff):
+            k = int(np.argmax(diff))
+            assert np.asarray(t.arity)[k] > 0  # only operator slots change
+
+
+def test_mutate_feature_stays_in_range(ctx, ops):
+    budget = branch_nu(ctx)["mutate_feature"]
+    changed = 0
+    for i, t in enumerate(_random_trees(ctx, N_TRIALS, seed=8)):
+        new, ok = mutate_feature(_u(budget, i), t, ctx)
+        assert bool(ok)
+        feats = np.asarray(new.feat)[: int(new.length)]
+        leaves = (
+            (np.asarray(new.arity)[: int(new.length)] == 0)
+            & (np.asarray(new.op)[: int(new.length)] == LEAF_VAR)
+        )
+        assert np.all(feats[leaves] < NFEAT)
+        diff = np.asarray(new.feat) != np.asarray(t.feat)
+        if np.any(diff):
+            assert np.sum(diff) == 1
+            k = int(np.argmax(diff))
+            # the changed leaf moved to a *different* feature
+            assert np.asarray(t.op)[k] == LEAF_VAR
+            changed += 1
+    assert changed > 0
+
+
+def test_mutate_feature_traced_nfeatures(ctx, ops):
+    # templates pass a traced per-key feature count; n=1 must be a no-op
+    budget = branch_nu(ctx)["mutate_feature"]
+    t = _random_trees(ctx, 1, seed=9)[0]
+    ctx_dyn = ctx._replace(nfeatures=jnp.int32(1))
+    new, ok = mutate_feature(_u(budget, 0), t, ctx_dyn)
+    np.testing.assert_array_equal(np.asarray(new.feat), np.asarray(t.feat))
+    ctx_dyn2 = ctx._replace(nfeatures=jnp.int32(2))
+    for i in range(10):
+        new, _ = mutate_feature(_u(budget, i), t, ctx_dyn2)
+        leaves = (
+            (np.asarray(new.arity)[: int(new.length)] == 0)
+            & (np.asarray(new.op)[: int(new.length)] == LEAF_VAR)
+        )
+        assert np.all(np.asarray(new.feat)[: int(new.length)][leaves] < 2)
+
+
+def test_randomize_tree_valid_and_bounded(ctx, ops):
+    budget = 1 + 8 * ctx.max_nodes
+    for i, t in enumerate(_random_trees(ctx, N_TRIALS, seed=10)):
+        new, ok = randomize_tree(_u(budget, i), t, jnp.int32(8), ctx)
+        assert bool(ok)
+        _assert_valid_postfix(new, ops, f"randomize {i}")
+        assert int(new.length) <= L
+
+
+def test_gen_random_tree_fixed_size_hits_target(ctx, ops):
+    for seed in range(15):
+        for target in (1, 3, 5, 8, 12):
+            t = gen_random_tree_fixed_size(
+                jax.random.key(seed * 31 + target), target, ctx, jnp.float32
+            )
+            _assert_valid_postfix(t, ops, f"gen {seed}/{target}")
+            # generator fills the remaining budget with a unary op when
+            # possible, so the size lands within 1 of the target
+            assert abs(int(t.length) - target) <= 1

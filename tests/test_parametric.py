@@ -174,3 +174,57 @@ def test_parameter_row_mutation():
     assert changed_rows.shape[0] == 1  # exactly one row scaled
     row = out[changed_rows[0]]
     assert np.allclose(row, row[0])  # whole row scaled by one factor
+
+
+def test_hof_csv_params_roundtrip_seeds_guesses(tmp_path, ops):
+    """Fitted parameter banks survive the CSV round trip: saved in the
+    Parameters column, loaded with return_params=True, and injected via
+    guesses=(expr, params) instead of randn reseeding."""
+    import jax
+
+    from symbolicregression_jl_tpu import Options, equation_search
+    from symbolicregression_jl_tpu.api.hall_of_fame import (
+        HallOfFame,
+        HallOfFameEntry,
+        load_hall_of_fame_csv,
+        save_hall_of_fame_csv,
+    )
+    from symbolicregression_jl_tpu.api.search import RuntimeOptions
+    from symbolicregression_jl_tpu.models import ParametricExpressionSpec
+
+    tree = parse_expression("p1 + (x1 * p2)", ops)
+    bank = np.asarray([[0.5, -1.0], [2.0, 3.0]], np.float32)  # [K=2, C=2]
+    hof = HallOfFame(entries=[
+        HallOfFameEntry(tree=tree, loss=0.1, cost=0.1, complexity=5,
+                        params=bank),
+    ])
+    path = str(tmp_path / "hof.csv")
+    save_hall_of_fame_csv(path, hof, ops)
+    trees, params = load_hall_of_fame_csv(path, ops, return_params=True)
+    assert len(trees) == 1 and params[0] is not None
+    np.testing.assert_allclose(params[0].reshape(2, 2), bank)
+
+    # Seed a parametric search with the loaded (tree, params) pair and
+    # check the bank lands in the population verbatim.
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-1, 1, (50, 1)).astype(np.float32)
+    cls = np.tile(np.array([0, 1]), 25)
+    y = (bank[0, cls] + X[:, 0] * bank[1, cls]).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "*"], unary_operators=[], maxsize=8,
+        populations=2, population_size=10, tournament_selection_n=4,
+        ncycles_per_iteration=2, save_to_file=False,
+        expression_spec=ParametricExpressionSpec(max_parameters=2),
+    )
+    # niterations=0: inspect the seeded state before evolution moves it.
+    state, _ = equation_search(
+        X, y, options=options, extra={"class": cls},
+        guesses=list(zip(trees, params)),
+        runtime_options=RuntimeOptions(niterations=0, seed=0, verbosity=0,
+                                       return_state=True),
+    )
+    pops_params = np.asarray(state.device_states[0].pops.params)
+    flat = pops_params.reshape(-1, 4)
+    assert any(
+        np.allclose(row, bank.reshape(-1), atol=1e-5) for row in flat
+    ), "seeded parameter bank not found in the population"

@@ -1,0 +1,115 @@
+"""Interactive quit + budget-check granularity (test_early_stop /
+stop-on-clock analogues, SURVEY.md §4; reference StdinReader,
+/root/reference/src/SearchUtils.jl:336-385).
+"""
+
+import io
+import time
+
+import numpy as np
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.api.search import RuntimeOptions
+from symbolicregression_jl_tpu.utils.stdin_quit import StdinQuitWatcher
+
+
+def _problem(n=100):
+    rng = np.random.default_rng(3)
+    X = rng.uniform(-2, 2, (n, 2)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options(**kw):
+    base = dict(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=10,
+        tournament_selection_n=4,
+        ncycles_per_iteration=8,
+        save_to_file=False,
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def test_watcher_reads_q():
+    w = StdinQuitWatcher(io.StringIO("q"), force=True)
+    deadline = time.time() + 5
+    while not w.check() and time.time() < deadline:
+        time.sleep(0.01)
+    assert w.check()
+
+
+def test_watcher_inactive_on_non_tty():
+    w = StdinQuitWatcher()  # pytest stdin is not a tty
+    assert not w.active
+    assert not w.check()
+
+
+def test_user_quit_stops_search(capsys):
+    X, y = _problem()
+    hof = equation_search(
+        X, y, options=_options(),
+        runtime_options=RuntimeOptions(
+            niterations=50, verbosity=1, seed=0,
+            input_stream=io.StringIO("q"),
+        ),
+    )
+    out = capsys.readouterr().out
+    assert "user_quit" in out
+    # results so far are preserved
+    assert len(hof.entries) > 0
+
+
+def test_timeout_checked_mid_iteration():
+    X, y = _problem()
+    t0 = time.time()
+    equation_search(
+        X, y,
+        options=_options(timeout_in_seconds=0.0, ncycles_per_iteration=64),
+        runtime_options=RuntimeOptions(niterations=1000, verbosity=0, seed=0),
+    )
+    # with a 0-second budget the search must stop within the very first
+    # chunk round, not run 1000 iterations
+    assert time.time() - t0 < 120
+
+
+def test_chunked_iteration_bit_identical():
+    """Chunked and single-launch iterations must produce identical
+    results: global cycle indices drive the annealing ramp and RNG
+    fold-ins, and the epilogue runs exactly once either way."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu import search_key
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+
+    X, y = _problem()
+    options = _options(ncycles_per_iteration=8)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+    engine = Engine(options, ds.nfeatures)
+
+    s1 = engine.init_state(search_key(7), ds.data, options.populations)
+    s1 = engine.run_iteration(s1, ds.data, options.maxsize)
+    s2 = engine.init_state(search_key(7), ds.data, options.populations)
+    s2 = engine.run_iteration(s2, ds.data, options.maxsize,
+                              chunk_sizes=[3, 3, 2])
+
+    np.testing.assert_array_equal(
+        np.asarray(s1.pops.trees.arity), np.asarray(s2.pops.trees.arity)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s1.pops.trees.op), np.asarray(s2.pops.trees.op)
+    )
+    np.testing.assert_allclose(
+        np.asarray(s1.pops.cost), np.asarray(s2.pops.cost), rtol=1e-6
+    )
+    assert float(s1.num_evals) == float(s2.num_evals)
+    np.testing.assert_array_equal(
+        jax.random.key_data(s1.key), jax.random.key_data(s2.key)
+    )

@@ -1,0 +1,291 @@
+"""Template/Composable expressions: ValidVector algebra, structure
+inference, batched template eval, evolution integration, and recovery of
+structured laws.
+
+Mirrors the reference's template suite (test/unit/expressions:
+test_composable_expression.jl, test_template_macro.jl,
+test_template_expression_mutation.jl, test_template_expression_string.jl
+and the templates MLJ integration group). Reference behavior:
+/root/reference/src/TemplateExpression.jl, ComposableExpression.jl,
+TemplateExpressionMacro.jl.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.models import (
+    ComposableExpression,
+    TemplateExpressionSpec,
+    ValidVector,
+    make_template_structure,
+    template_spec,
+)
+from symbolicregression_jl_tpu.models.template import (
+    TemplateReturnError,
+    eval_template_batch,
+)
+from symbolicregression_jl_tpu.ops.encoding import TreeBatch, encode_population
+from symbolicregression_jl_tpu.ops.operators import OperatorSet
+from symbolicregression_jl_tpu.ops.tree import parse_expression
+
+
+@pytest.fixture(scope="module")
+def ops():
+    return OperatorSet(
+        binary_operators=["+", "-", "*", "/"], unary_operators=["cos", "sin"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# ValidVector algebra (reference ComposableExpression.jl:263-289, :353-388)
+# ---------------------------------------------------------------------------
+
+
+def test_validvector_arithmetic_and_validity():
+    a = ValidVector(jnp.asarray([1.0, 4.0]), jnp.bool_(True))
+    b = ValidVector(jnp.asarray([2.0, 0.5]), jnp.bool_(True))
+    out = a * b + 1.0
+    np.testing.assert_allclose(np.asarray(out.x), [3.0, 3.0])
+    assert bool(out.valid)
+    # division producing inf invalidates
+    z = a / ValidVector(jnp.asarray([0.0, 1.0]), jnp.bool_(True))
+    assert not bool(z.valid)
+    # invalidity propagates through later ops
+    assert not bool((z + 1.0).valid)
+
+
+def test_validvector_named_fns_safe_domains():
+    from symbolicregression_jl_tpu.models.composable import log, sqrt
+
+    ok = log(ValidVector(jnp.asarray([1.0, 2.0]), jnp.bool_(True)))
+    assert bool(ok.valid)
+    bad = sqrt(ValidVector(jnp.asarray([-1.0, 4.0]), jnp.bool_(True)))
+    assert not bool(bad.valid)
+
+
+# ---------------------------------------------------------------------------
+# ComposableExpression host semantics (reference :198-256)
+# ---------------------------------------------------------------------------
+
+
+def test_composable_call_evaluates(ops):
+    f = ComposableExpression(
+        parse_expression("x1 * x2", ops, variable_names=["x1", "x2"]), ops, 2
+    )
+    x = np.asarray([1.0, 2.0, 3.0], np.float32)
+    out = f(x, 2.0 * x)
+    np.testing.assert_allclose(np.asarray(out), 2.0 * x * x, rtol=1e-6)
+
+
+def test_composable_composition_splices_trees(ops):
+    f = ComposableExpression(
+        parse_expression("x1 * x2", ops, variable_names=["x1", "x2"]), ops, 2
+    )
+    g = ComposableExpression(
+        parse_expression("cos(x1)", ops, variable_names=["x1"]), ops, 1
+    )
+    h = f(g, g)  # cos(#1)^2
+    assert h.string() == "cos(#1) * cos(#1)"
+    val = h(np.float32(0.3))
+    assert abs(val - np.cos(0.3) ** 2) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# Structure building / inference (reference TemplateExpression.jl:213-241,
+# TemplateExpressionMacro.jl:34-151)
+# ---------------------------------------------------------------------------
+
+
+def test_template_spec_infers_arities():
+    spec = template_spec(expressions=("f", "g"))(
+        lambda f, g, x1, x2, x3: f(x1, x2) + g(x3)
+    )
+    st = spec.structure
+    assert st.expr_keys == ("f", "g")
+    assert st.num_features == (2, 1)
+    assert st.n_variables == 3
+    assert not st.has_params
+
+
+def test_template_spec_with_parameters():
+    spec = template_spec(expressions=("f",), parameters={"p": 3})(
+        lambda f, x1, p: f(x1) * p[0] + p[1] - p[2]
+    )
+    st = spec.structure
+    assert st.param_keys == ("p",)
+    assert st.num_params == (3,)
+    assert st.total_params == 3
+
+
+def test_inconsistent_arity_raises():
+    with pytest.raises(ValueError, match="Inconsistent"):
+        template_spec(expressions=("f",))(
+            lambda f, x1, x2: f(x1) + f(x1, x2)
+        )
+
+
+def test_uncalled_subexpression_raises():
+    with pytest.raises(ValueError, match="never called|Failed to infer"):
+        template_spec(expressions=("f", "g"))(lambda f, g, x1: f(x1))
+
+
+def test_make_template_structure_reference_style():
+    st = make_template_structure(
+        lambda exprs, xs: exprs.f(xs[0], xs[1]) + exprs.g(xs[2]),
+        expressions=("f", "g"),
+        n_variables=3,
+    )
+    assert st.num_features == (2, 1)
+
+
+# ---------------------------------------------------------------------------
+# Batched template evaluation (reference :684-711)
+# ---------------------------------------------------------------------------
+
+
+def _encode_template(ops, exprs, L=8):
+    encs = encode_population(exprs, L, ops)
+    return TreeBatch(
+        arity=encs.arity[None], op=encs.op[None], feat=encs.feat[None],
+        const=encs.const[None], length=encs.length[None],
+    )
+
+
+def test_eval_template_batch_matches_numpy(ops):
+    spec = template_spec(expressions=("f", "g"))(
+        lambda f, g, x1, x2, x3: f(x1, x2) + g(x3) * 2.0
+    )
+    st = spec.structure
+    trees = _encode_template(ops, [
+        parse_expression("x1 * x2", ops, variable_names=["x1", "x2"]),
+        parse_expression("cos(x1)", ops, variable_names=["x1"]),
+    ])
+    X = np.random.default_rng(0).normal(size=(3, 40)).astype(np.float32)
+    y, valid = eval_template_batch(trees, jnp.asarray(X), st, ops)
+    assert bool(valid[0])
+    np.testing.assert_allclose(
+        np.asarray(y[0]), X[0] * X[1] + np.cos(X[2]) * 2.0, rtol=1e-5
+    )
+
+
+def test_eval_template_invalid_propagates(ops):
+    # g = 1/#1 on data containing 0 -> invalid member
+    spec = template_spec(expressions=("g",))(lambda g, x1: g(x1))
+    trees = _encode_template(ops, [
+        parse_expression("1.0 / x1", ops, variable_names=["x1"]),
+    ])
+    X = np.asarray([[0.0, 1.0]], np.float32)
+    y, valid = eval_template_batch(trees, jnp.asarray(X), spec.structure, ops)
+    assert not bool(valid[0])
+
+
+def test_combiner_must_return_validvector():
+    with pytest.raises(TemplateReturnError):
+        template_spec(expressions=("f",))(lambda f, x1: np.float32(1.0))
+
+
+def test_template_nested_composition_eval(ops):
+    # combiner may feed one subexpression's output into another
+    # (reference :94-98: `f(x1 + g(x2)) - g(x1)` style reuse)
+    spec = template_spec(expressions=("f", "g"))(
+        lambda f, g, x1, x2: f(g(x1), x2) + g(x2)
+    )
+    trees = _encode_template(ops, [
+        parse_expression("x1 + x2", ops, variable_names=["x1", "x2"]),
+        parse_expression("sin(x1)", ops, variable_names=["x1"]),
+    ])
+    X = np.random.default_rng(1).normal(size=(2, 30)).astype(np.float32)
+    y, valid = eval_template_batch(trees, jnp.asarray(X), spec.structure, ops)
+    expect = (np.sin(X[0]) + X[1]) + np.sin(X[1])
+    assert bool(valid[0])
+    np.testing.assert_allclose(np.asarray(y[0]), expect, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Search integration
+# ---------------------------------------------------------------------------
+
+
+def test_template_search_recovers_structured_law():
+    spec = template_spec(expressions=("f", "g"))(
+        lambda f, g, x1, x2, x3: f(x1, x2) + g(x3)
+    )
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (300, 3)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 2.0 * np.cos(X[:, 2])).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        maxsize=14,
+        populations=6,
+        population_size=24,
+        ncycles_per_iteration=12,
+        optimizer_probability=0.2,
+        expression_spec=spec,
+        save_to_file=False,
+    )
+    hof = equation_search(X, y, options=options, niterations=12, seed=2,
+                          verbosity=0)
+    best = min(e.loss for e in hof.entries)
+    assert best < 0.1, f"template search did not converge (loss={best})"
+    # every decoded entry respects per-key feature arities
+    for e in hof.entries:
+        st = e.template_expr.structure
+        for k, key in enumerate(st.expr_keys):
+            tree = e.template_expr.trees[key]
+            feats = [
+                n.feature for n in tree.nodes()
+                if n.degree == 0 and not n.constant and not n.is_parameter
+            ]
+            assert all(f < st.num_features[k] for f in feats)
+
+
+def test_template_search_with_parameters_recovers_exact():
+    spec = template_spec(expressions=("f",), parameters={"p": 2})(
+        lambda f, x1, x2, p: f(x1) + p[0] * x2 + p[1]
+    )
+    rng = np.random.default_rng(1)
+    X = rng.uniform(-2, 2, (200, 2)).astype(np.float32)
+    y = (X[:, 0] ** 2 + 3.0 * X[:, 1] - 0.5).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=4,
+        population_size=20,
+        ncycles_per_iteration=8,
+        optimizer_probability=0.3,
+        expression_spec=spec,
+        save_to_file=False,
+    )
+    hof = equation_search(X, y, options=options, niterations=8, seed=0,
+                          verbosity=0)
+    best = min(hof.entries, key=lambda e: e.loss)
+    assert best.loss < 1e-6
+    # fitted parameters should be ~[3, -0.5]
+    params = best.template_expr.params
+    assert params is not None
+    np.testing.assert_allclose(sorted(params), [-0.5, 3.0], atol=1e-2)
+    # host prediction matches data
+    pred = best.template_expr(X)
+    np.testing.assert_allclose(pred, y, atol=1e-2)
+
+
+def test_template_hof_string_and_spec_validation(ops):
+    spec = template_spec(expressions=("f",))(lambda f, x1: f(x1))
+    with pytest.raises(ValueError, match="variables"):
+        X = np.zeros((10, 3), np.float32)
+        equation_search(
+            X, np.zeros(10, np.float32),
+            options=Options(expression_spec=spec, save_to_file=False,
+                            populations=2, population_size=8,
+                            tournament_selection_n=4,
+                            ncycles_per_iteration=2),
+            niterations=1, verbosity=0,
+        )
+    with pytest.raises(ValueError, match="TemplateStructure"):
+        TemplateExpressionSpec(structure="not a structure")
