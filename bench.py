@@ -53,14 +53,15 @@ def main() -> None:
 
     # Island count is the TPU-native scaling axis (SURVEY.md §2.4): more
     # islands amortize the per-cycle machinery over more concurrent
-    # evaluations in the same launches.
+    # evaluations in the same launches (profiling/config_sweep.py picks
+    # the config).
     options = Options(
         binary_operators=["+", "-", "*", "/"],
         unary_operators=["exp", "abs", "cos"],
         maxsize=30,
-        populations=128,
-        population_size=128,
-        tournament_selection_n=8,
+        populations=256,
+        population_size=256,
+        tournament_selection_n=16,
         ncycles_per_iteration=100,
         save_to_file=False,
     )

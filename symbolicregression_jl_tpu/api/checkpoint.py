@@ -49,22 +49,21 @@ def options_compat_header(options: "Options") -> dict:
             spec_desc, st.expr_keys, st.num_features, st.param_keys,
             st.num_params, st.n_variables,
         )
-    return {
-        "operators": (
-            tuple(op.name for op in options.operators.unary),
-            tuple(op.name for op in options.operators.binary),
-        ),
-        "maxsize": options.maxsize,
-        "maxdepth": options.maxdepth,
-        "loss_scale": options.loss_scale,
-        "parsimony": options.parsimony,
-        "dimensional_constraint_penalty": options.dimensional_constraint_penalty,
-        "batching": options.batching,
-        "batch_size": options.batch_size,
-        "population_size": options.population_size,
-        "populations": options.populations,
-        "expression_spec": spec_desc,
+    # Field list comes from the same source as the in-memory warm-start
+    # check (Options._WARM_START_FIELDS) so the two can't drift — for
+    # disk resumes this header IS the compatibility check (the loaded
+    # SearchState carries the *new* options).
+    header = {
+        f: getattr(options, f)
+        for f in type(options)._WARM_START_FIELDS
+        if f != "expression_spec"
     }
+    header["operators"] = (
+        tuple(op.name for op in options.operators.unary),
+        tuple(op.name for op in options.operators.binary),
+    )
+    header["expression_spec"] = spec_desc
+    return header
 
 
 def _to_numpy_state(ds):
@@ -73,7 +72,7 @@ def _to_numpy_state(ds):
     return jax.tree.map(np.asarray, jax.device_get(ds))
 
 
-def _to_device_state(ds, key_impl: str = "rbg"):
+def _to_device_state(ds, key_impl: str = "threefry2x32"):
     return dataclasses.replace(
         ds, key=jax.random.wrap_key_data(
             jax.numpy.asarray(ds.key), impl=key_impl
@@ -91,7 +90,7 @@ def save_search_state(path: str, state: "SearchState") -> None:
         "format_version": _FORMAT_VERSION,
         "compat": options_compat_header(state.options),
         "num_evals": float(state.num_evals),
-        "key_impl": "rbg",
+        "key_impl": "threefry2x32",
         "nfeatures": state.nfeatures,
         "device_states": [_to_numpy_state(ds) for ds in state.device_states],
     }
@@ -125,7 +124,7 @@ def load_search_state(path: str, options: "Options") -> "SearchState":
             f"Checkpoint incompatible with current options; changed: {issues}"
         )
     device_states = [
-        _to_device_state(ds, payload.get("key_impl", "rbg"))
+        _to_device_state(ds, payload.get("key_impl", "threefry2x32"))
         for ds in payload["device_states"]
     ]
     return SearchState(

@@ -568,17 +568,13 @@ def equation_search(
                     os.path.join(out_dir, fname), hofs[j], options.operators,
                     variable_names=ds.variable_names,
                 )
-        if out_dir is not None and (
-            it % ropt.checkpoint_every_n == 0
-            or stop_reason is not None
-            or it == ropt.niterations
-        ):
-            # Full-state checkpoint next to the CSVs: kill the process at
-            # a checkpoint boundary and resume with
-            # equation_search(..., saved_state=<path>). Written every
-            # checkpoint_every_n iterations (not every iteration — the
-            # population pytree is much larger than the HoF CSVs) plus
-            # always at the final/stopping iteration.
+        if out_dir is not None and it % ropt.checkpoint_every_n == 0:
+            # Periodic full-state checkpoint next to the CSVs: kill the
+            # process at a checkpoint boundary and resume with
+            # equation_search(..., saved_state=<path>). Not every
+            # iteration — the population pytree is much larger than the
+            # HoF CSVs; the final/stopping state is written once after
+            # the loop.
             from .checkpoint import save_search_state
 
             save_search_state(

@@ -362,6 +362,23 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
         class_idx = (
             None if data.class_idx is None else jnp.take(data.class_idx, batch_idx)
         )
+    def _loss_from_pred(pred, valid):
+        """Loss from (pred, valid): the custom whole-prediction hook
+        (loss_function / loss_function_expression,
+        src/LossFunctions.jl:139-159) or the elementwise path — shared by
+        the template and plain branches so the custom-loss contract can't
+        diverge between them."""
+        if loss_function is None:
+            return aggregate_loss(elementwise_loss, pred, y, valid, w)
+        flat_pred = pred.reshape(-1, pred.shape[-1])
+        flat_valid = valid.reshape(-1)
+        loss = jax.vmap(lambda p, v: loss_function(p, y, w, v))(
+            flat_pred, flat_valid
+        ).reshape(valid.shape)
+        return jnp.where(
+            valid & ~jnp.isnan(loss), loss, jnp.asarray(jnp.inf, loss.dtype)
+        )
+
     if template is not None:
         # Template eval: combiner over subexpression callables
         # (/root/reference/src/TemplateExpression.jl:684-711); complexity
@@ -377,18 +394,7 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
         )
         pred, valid = eval_template_batch(trees, X, template, operators,
                                           params=t_params)
-        if loss_function is not None:
-            flat_pred = pred.reshape(-1, pred.shape[-1])
-            flat_valid = valid.reshape(-1)
-            loss = jax.vmap(lambda p, v: loss_function(p, y, w, v))(
-                flat_pred, flat_valid
-            ).reshape(valid.shape)
-            loss = jnp.where(
-                valid & ~jnp.isnan(loss), loss,
-                jnp.asarray(jnp.inf, loss.dtype),
-            )
-        else:
-            loss = aggregate_loss(elementwise_loss, pred, y, valid, w)
+        loss = _loss_from_pred(pred, valid)
         complexity = jnp.sum(compute_complexity_batch(trees, tables), axis=-1)
         cost = loss_to_cost(loss, data.baseline_loss, data.use_baseline,
                             complexity, parsimony)
@@ -406,21 +412,7 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
         )
     else:
         pred, valid = eval_tree_batch(trees, X, operators, params=params)
-        if loss_function is not None:
-            # Custom whole-prediction loss (the loss_function /
-            # loss_function_expression hook, src/LossFunctions.jl:139-159):
-            # a jnp-traceable (pred[n], y[n], weights, valid) -> scalar.
-            flat_pred = pred.reshape(-1, pred.shape[-1])
-            flat_valid = valid.reshape(-1)
-            loss = jax.vmap(lambda p, v: loss_function(p, y, w, v))(
-                flat_pred, flat_valid
-            ).reshape(valid.shape)
-            loss = jnp.where(
-                valid & ~jnp.isnan(loss), loss,
-                jnp.asarray(jnp.inf, loss.dtype),
-            )
-        else:
-            loss = aggregate_loss(elementwise_loss, pred, y, valid, w)
+        loss = _loss_from_pred(pred, valid)
     complexity = compute_complexity_batch(trees, tables)
     cost = loss_to_cost(loss, data.baseline_loss, data.use_baseline, complexity,
                         parsimony)
@@ -622,18 +614,76 @@ def generation_step(
      needs_eval1, needs_eval2, accept_u) = jax.vmap(slot_fn)(keys)
 
     # ---- one fused eval launch over all candidates ----
-    both = jax.tree.map(
-        lambda a, b: jnp.stack([a, b], axis=1), cand1, cand2
-    )  # [B, 2, ...]
-    both_params = jnp.stack([cand1_params, cand2_params], axis=1)  # [B,2,K,C]
-    cost, loss, complexity = eval_cost_batch(
-        both, data, elementwise_loss, tables, cfg.operators, cfg.parsimony,
-        batch_idx=batch_idx, member_params=both_params,
-        turbo=cfg.turbo, interpret=cfg.interpret,
-        loss_function=options.resolved_loss_function,
-        dim_penalty=cfg.dim_penalty, wildcard_constants=cfg.wildcard_constants,
-        template=cfg.template,
-    )
+    # cand2 (crossover's second child) matters only on crossover slots —
+    # ~p_crossover of them (default 0.066). Evaluating it everywhere would
+    # double the eval work for a ~7% hit rate, so a small top-k pool of
+    # crossover slots is packed into the launch instead; the pool is sized
+    # ~3 sigma above the binomial mean, and the (rare) overflow slots fall
+    # back to "crossover failed" (parents kept), matching a constraint
+    # rejection. (See profiling/RESULTS.md.)
+    p_x = cfg.crossover_probability
+    import math as _math
+
+    if p_x <= 0.0:
+        k2 = 0
+    elif p_x >= 0.5:
+        k2 = B
+    else:
+        k2 = min(B, int(_math.ceil(
+            B * p_x + 3.0 * _math.sqrt(B * p_x * (1.0 - p_x)) + 1.0
+        )))
+
+    def _eval(trees, params):
+        return eval_cost_batch(
+            trees, data, elementwise_loss, tables, cfg.operators,
+            cfg.parsimony, batch_idx=batch_idx, member_params=params,
+            turbo=cfg.turbo, interpret=cfg.interpret,
+            loss_function=options.resolved_loss_function,
+            dim_penalty=cfg.dim_penalty,
+            wildcard_constants=cfg.wildcard_constants,
+            template=cfg.template,
+        )
+
+    if 0 < k2 < B:
+        _, sel2 = jax.lax.top_k(is_xover.astype(jnp.float32), k2)
+        cand2_sel = jax.tree.map(lambda x: x[sel2], cand2)
+        params2_sel = cand2_params[sel2]
+        packed = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), cand1, cand2_sel
+        )  # [B + k2, ...]
+        packed_params = jnp.concatenate([cand1_params, params2_sel], axis=0)
+        c_all, l_all, x_all = _eval(packed, packed_params)
+        inf = jnp.asarray(jnp.inf, c_all.dtype)
+
+        def unpack(v, default):
+            v2 = jnp.full((B,), default, v.dtype).at[sel2].set(v[B:])
+            return jnp.stack([v[:B], v2], axis=1)
+
+        cost = unpack(c_all, inf)
+        loss = unpack(l_all, inf)
+        complexity = unpack(x_all, jnp.int32(1))
+        # slots beyond the pool didn't get cand2 evaluated: treat as a
+        # failed crossover (no replacement, no eval counted)
+        xover_rank = jnp.cumsum(is_xover.astype(jnp.int32)) - 1
+        overflow = is_xover & (xover_rank >= k2)
+        xo_success = xo_success & ~overflow
+        needs_eval2 = needs_eval2 & ~overflow
+    else:
+        if k2 == 0:
+            # crossover disabled: cand2 is never consulted
+            cost1, loss1, cx1 = _eval(cand1, cand1_params)
+            inf = jnp.asarray(jnp.inf, cost1.dtype)
+            cost = jnp.stack([cost1, jnp.full((B,), inf)], axis=1)
+            loss = jnp.stack([loss1, jnp.full((B,), inf)], axis=1)
+            complexity = jnp.stack(
+                [cx1, jnp.ones((B,), jnp.int32)], axis=1
+            )
+        else:
+            both = jax.tree.map(
+                lambda a, b: jnp.stack([a, b], axis=1), cand1, cand2
+            )  # [B, 2, ...]
+            both_params = jnp.stack([cand1_params, cand2_params], axis=1)
+            cost, loss, complexity = _eval(both, both_params)
     needs_eval = jnp.stack([needs_eval1, needs_eval2], axis=1)
     num_evals = jnp.sum(needs_eval.astype(jnp.float32))
 

@@ -75,7 +75,7 @@ def test_parametric_search_recovers_per_class_offsets():
         save_to_file=False,
     )
     hof = equation_search(
-        X, y, options=opts, niterations=8, verbosity=0, seed=0,
+        X, y, options=opts, niterations=12, verbosity=0, seed=0,
         extra={"class": cls},
     )
     best = min(hof.entries, key=lambda e: e.loss)
