@@ -1,0 +1,97 @@
+"""BASELINE.json config-5 bench: TemplateExpression multi-subtree eval.
+
+Measures (a) batched template evaluation throughput (members/s over the
+full dataset) and (b) a short template search's evals/s, on the
+reference-style structured law  y = f(x1, x2) + g(x3)  with
+f = x1*x2, g = 2 cos(x3) (10k rows).
+
+Run on the TPU: python profiling/template_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from _common import N_FEATURES, N_ROWS, make_bench_problem  # noqa: F401  (path setup)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.models import template_spec
+    from symbolicregression_jl_tpu.models.template import eval_template_batch
+    from symbolicregression_jl_tpu.evolve.population import (
+        init_template_population,
+    )
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+
+    spec = template_spec(expressions=("f", "g"))(
+        lambda f, g, x1, x2, x3: f(x1, x2) + g(x3)
+    )
+    st = spec.structure
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-3, 3, (10_000, 3)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 2.0 * np.cos(X[:, 2])).astype(np.float32)
+
+    options = sr.Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        maxsize=20,
+        populations=16,
+        population_size=33,
+        ncycles_per_iteration=40,
+        expression_spec=spec,
+        save_to_file=False,
+    )
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+    engine = Engine(options, ds.nfeatures, template=st)
+
+    # (a) raw batched template eval throughput
+    T = 512
+    trees = init_template_population(
+        sr.search_key(0), T, st, engine.cfg.mctx, jnp.float32
+    )
+
+    @jax.jit
+    def prog(tr):
+        def body(c, _):
+            yv, valid = eval_template_batch(tr, ds.data.Xt, st,
+                                            options.operators)
+            return c + jnp.sum(jnp.where(valid, yv[:, 0], 0.0)), None
+        out, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=10)
+        return out
+
+    out = prog(trees)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = prog(trees)
+    jax.block_until_ready(out)
+    eval_rate = T * 10 / (time.perf_counter() - t0)
+
+    # (b) short search evals/s
+    state = engine.init_state(sr.search_key(0), ds.data, options.populations)
+    state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    ev0 = float(state.num_evals)
+    t0 = time.perf_counter()
+    for _ in range(2):
+        state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    search_rate = (float(state.num_evals) - ev0) / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "template_config5_eval_and_search",
+        "template_eval_members_per_sec_10k_rows": round(eval_rate, 1),
+        "template_search_evals_per_sec_10k_rows": round(search_rate, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -347,6 +347,16 @@ class SRRegressor:
             ]
         return self.equations_[self.best_idx_]
 
+    @staticmethod
+    def _export_tree(rec):
+        if rec.tree is None:
+            raise NotImplementedError(
+                "latex/sympy export is not supported for template "
+                "expressions — use the record's `.equation` string "
+                "(per-subexpression strings via .template_expr)"
+            )
+        return rec.tree
+
     def latex(self, idx: Optional[int] = None) -> Union[str, List[str]]:
         """LaTeX form of the selected equation(s)."""
         from ..utils.export import to_latex
@@ -354,12 +364,12 @@ class SRRegressor:
         self._check_fitted()
         if self._MULTITARGET:
             return [
-                to_latex(recs[i if idx is None else idx].tree,
+                to_latex(self._export_tree(recs[i if idx is None else idx]),
                          variable_names=self.variable_names_)
                 for recs, i in zip(self.equations_, self.best_idx_)
             ]
         i = int(idx) if idx is not None else int(self.best_idx_)
-        return to_latex(self.equations_[i].tree,
+        return to_latex(self._export_tree(self.equations_[i]),
                         variable_names=self.variable_names_)
 
     def sympy(self, idx: Optional[int] = None):
@@ -369,12 +379,12 @@ class SRRegressor:
         self._check_fitted()
         if self._MULTITARGET:
             return [
-                to_sympy(recs[i if idx is None else idx].tree,
+                to_sympy(self._export_tree(recs[i if idx is None else idx]),
                          variable_names=self.variable_names_)
                 for recs, i in zip(self.equations_, self.best_idx_)
             ]
         i = int(idx) if idx is not None else int(self.best_idx_)
-        return to_sympy(self.equations_[i].tree,
+        return to_sympy(self._export_tree(self.equations_[i]),
                         variable_names=self.variable_names_)
 
     def __repr__(self) -> str:  # pragma: no cover

@@ -169,6 +169,87 @@ def _parse_guess(
     return parse_expression(str(guess), operators, variable_names=variable_names)
 
 
+def _encode_template_seeds(
+    engine: Engine, items, operators
+) -> Tuple[TreeBatch, List[Optional[np.ndarray]]]:
+    """Encode template guesses — HostTemplateExpression, template
+    strings ('f = ...; g = ...'), or {key: expr} dicts — into a
+    [n, K, L] TreeBatch plus per-seed parameter vectors."""
+    from ..models.template import (
+        HostTemplateExpression,
+        parse_template_expression,
+    )
+
+    st = engine.template
+    encs, params = [], []
+    for expr, gp in items:
+        if isinstance(expr, HostTemplateExpression):
+            h = expr
+        elif isinstance(expr, str):
+            h = parse_template_expression(expr, st, operators)
+        elif isinstance(expr, dict):
+            missing = [k for k in st.expr_keys if k not in expr]
+            if missing:
+                raise ValueError(
+                    f"Template guess dict missing subexpressions: {missing} "
+                    f"(keys: {st.expr_keys})"
+                )
+            unknown = [
+                k for k in expr
+                if k not in st.expr_keys and k not in st.param_keys
+            ]
+            if unknown:
+                raise ValueError(
+                    f"Template guess dict has unknown keys: {unknown} "
+                    f"(expressions: {st.expr_keys}, parameters: {st.param_keys})"
+                )
+            trees = {}
+            for k, key in enumerate(st.expr_keys):
+                v = expr[key]
+                names = [f"x{i + 1}" for i in range(max(st.num_features[k], 1))]
+                trees[key] = (
+                    v if isinstance(v, Node)
+                    else parse_expression(str(v).replace("#", "x"), operators,
+                                          variable_names=names)
+                )
+            # Parameter vectors may ride the dict under their own keys.
+            h_params = None
+            if st.has_params and any(k in expr for k in st.param_keys):
+                missing_p = [k for k in st.param_keys if k not in expr]
+                if missing_p:
+                    raise ValueError(
+                        f"Template guess dict sets some parameter vectors "
+                        f"but is missing: {missing_p}"
+                    )
+                h_params = np.concatenate([
+                    np.asarray(expr[k], np.float64).reshape(-1)
+                    for k in st.param_keys
+                ])
+                if h_params.shape[0] != st.total_params:
+                    raise ValueError(
+                        f"Template guess parameters have "
+                        f"{h_params.shape[0]} values; expected "
+                        f"{st.total_params}"
+                    )
+            h = HostTemplateExpression(trees=trees, structure=st,
+                                       operators=operators, params=h_params)
+        else:
+            raise TypeError(
+                f"Template guess must be a template string, dict, or "
+                f"HostTemplateExpression; got {type(expr).__name__}"
+            )
+        encs.append(h.encode(engine.cfg.max_nodes, dtype=np.dtype(engine.dtype)))
+        params.append(gp if gp is not None else h.params)
+    batch = TreeBatch(
+        arity=jnp.stack([e.arity for e in encs]),
+        op=jnp.stack([e.op for e in encs]),
+        feat=jnp.stack([e.feat for e in encs]),
+        const=jnp.stack([e.const for e in encs]),
+        length=jnp.stack([e.length for e in encs]),
+    )
+    return batch, params
+
+
 def _seed_population(
     engine: Engine,
     state: SearchDeviceState,
@@ -176,6 +257,7 @@ def _seed_population(
     data,
     mode: str,
     params: Optional[Sequence[Optional[np.ndarray]]] = None,
+    encoded: Optional[TreeBatch] = None,
 ) -> SearchDeviceState:
     """Inject host trees into the device population (guess seeding /
     initial_population, src/SearchUtils.jl:738-835 and the fork's
@@ -186,15 +268,21 @@ def _seed_population(
     ``mode='tile'`` tiles seeds across all islands' member slots
     (initial_population semantics). ``params``: optional per-seed fitted
     parameter banks (flat or (n_params, n_classes)); seeds without one
-    get fresh randn banks.
+    get fresh randn banks. ``encoded``: pre-encoded seed TreeBatch
+    (template members) — bypasses host-Node encoding.
     """
-    if not trees:
+    if encoded is None and not trees:
         return state
     cfg = engine.cfg
     I = state.birth.shape[0]
     P = cfg.population_size
-    enc = encode_population(
-        list(trees)[: I * P], cfg.max_nodes, cfg.operators, np.dtype(engine.dtype)
+    enc = (
+        encoded
+        if encoded is not None
+        else encode_population(
+            list(trees)[: I * P], cfg.max_nodes, cfg.operators,
+            np.dtype(engine.dtype),
+        )
     )
     n_seed = enc.length.shape[0]
     # Parametric: seeds get fresh randn parameter banks (extra_init_params
@@ -218,6 +306,29 @@ def _seed_population(
             sp[i] = p
         seed_params = jnp.asarray(sp)
     cost, loss, cx = engine._eval_cost(enc, data, seed_params)
+
+    if mode == "replace_worst":
+        # Guesses also enter the hall of fame directly (the reference
+        # injects parsed guesses into the HoF before migrating them into
+        # populations, src/SymbolicRegression.jl:779-787) — otherwise an
+        # exact seed can be evolved over before any per-cycle HoF update
+        # records it.
+        from ..evolve.population import PopulationState
+        from ..evolve.step import update_hof
+
+        seeds_pop = PopulationState(
+            trees=enc,
+            cost=cost,
+            loss=loss,
+            complexity=cx,
+            birth=jnp.zeros((n_seed,), jnp.int32),
+            ref=jnp.zeros((n_seed,), jnp.int32),
+            parent=jnp.full((n_seed,), -1, jnp.int32),
+            params=seed_params,
+        )
+        state = dataclasses.replace(
+            state, hof=update_hof(state.hof, seeds_pop, engine.cfg.maxsize)
+        )
 
     pops = state.pops
     if mode == "tile":
@@ -402,11 +513,6 @@ def equation_search(
                     f"Template combiner consumes {template.n_variables} "
                     f"variables but the dataset has {ds.nfeatures} features"
                 )
-            if guesses is not None or initial_population:
-                raise NotImplementedError(
-                    "guesses / initial_population seeding is not yet "
-                    "supported for template expressions"
-                )
         engine = Engine(options, ds.nfeatures, dtype=_np_dtype(options.eval_dtype),
                         n_params=n_params, n_classes=n_classes,
                         template=template, n_data_shards=ropt.n_data_shards)
@@ -447,31 +553,53 @@ def equation_search(
         else:
             state = engine.init_state(k_init, data, n_islands)
             if initial_population:
-                trees = [
-                    _parse_guess(g, options.operators, ds.variable_names, ds.nfeatures)
-                    for g in initial_population
-                ]
-                state = _seed_population(engine, state, trees, data, mode="tile")
+                if template is not None:
+                    enc, gparams = _encode_template_seeds(
+                        engine, [(g, None) for g in initial_population],
+                        options.operators,
+                    )
+                    state = _seed_population(
+                        engine, state, [], data, mode="tile",
+                        params=gparams, encoded=enc,
+                    )
+                else:
+                    trees = [
+                        _parse_guess(g, options.operators, ds.variable_names,
+                                     ds.nfeatures)
+                        for g in initial_population
+                    ]
+                    state = _seed_population(
+                        engine, state, trees, data, mode="tile"
+                    )
         if guesses is not None:
             gs = guesses[j] if _is_nested(guesses, len(datasets)) else guesses
-            # A guess is an expression (string/Node), or a tuple
-            # (expression, fitted_params) — the shape produced by
+            # A guess is an expression (string/Node/template string), or
+            # a tuple (expression, fitted_params) — the shape produced by
             # load_hall_of_fame_csv(return_params=True).
-            trees, gparams = [], []
+            items = []
             for g in gs:
                 if _is_guess_pair(g):
-                    expr, gp = g
+                    items.append(g)
                 else:
-                    expr, gp = g, None
-                trees.append(
+                    items.append((g, None))
+            if template is not None:
+                enc, gparams = _encode_template_seeds(
+                    engine, items, options.operators
+                )
+                state = _seed_population(
+                    engine, state, [], data, mode="replace_worst",
+                    params=gparams, encoded=enc,
+                )
+            else:
+                trees = [
                     _parse_guess(expr, options.operators, ds.variable_names,
                                  ds.nfeatures)
+                    for expr, _ in items
+                ]
+                state = _seed_population(
+                    engine, state, trees, data, mode="replace_worst",
+                    params=[gp for _, gp in items],
                 )
-                gparams.append(gp)
-            state = _seed_population(
-                engine, state, trees, data, mode="replace_worst",
-                params=gparams,
-            )
         state = shard_search_state(state, mesh)
         engines.append(engine)
         states.append(state)
@@ -535,18 +663,31 @@ def equation_search(
             stop_reason = _budget_stop(pending_evals)
         return stop_reason is not None
 
+    # Host-overhead tracking (ResourceMonitor analogue,
+    # src/SearchUtils.jl:411-438).
+    from ..utils.monitor import ResourceMonitor
+
+    monitor = ResourceMonitor()
+    host_t0 = time.time()
+
     it = 0
     while it < ropt.niterations and stop_reason is None:
         cur_maxsize = get_cur_maxsize(
             options.maxsize, options.warmup_maxsize_by, total_cycles,
             cycles_remaining,
         )
+        dev_t0 = time.time()
+        monitor_host = dev_t0 - host_t0  # bookkeeping since last iteration
         for j, (engine, data) in enumerate(zip(engines, datas)):
             states[j] = engine.run_iteration(
                 states[j], data, cur_maxsize,
                 chunk_sizes=chunk_sizes if len(chunk_sizes) > 1 else None,
                 should_stop=_budget_hit,
             )
+        jax.block_until_ready(states[-1].pops.cost)
+        host_t0 = time.time()
+        monitor.record(host_t0 - dev_t0, monitor_host)
+        monitor.check_and_warn(ropt.verbosity)
         cycles_remaining -= options.ncycles_per_iteration
         it += 1
 
@@ -613,7 +754,8 @@ def equation_search(
                 print(
                     f"[iter {it}/{ropt.niterations}] "
                     f"best_loss={best_loss:.6g} evals={total_evals:.3g} "
-                    f"({rate:.3g}/s)"
+                    f"({rate:.3g}/s, host "
+                    f"{monitor.estimate_work_fraction():.0%})"
                 )
 
         # ---- early stopping (src/SearchUtils.jl:387-409) ----
@@ -688,11 +830,14 @@ def equation_search(
 
 def _is_guess_pair(g) -> bool:
     """An (expression, fitted_params) guess — the element shape produced
-    by load_hall_of_fame_csv(return_params=True)."""
+    by load_hall_of_fame_csv(return_params=True). The expression may be
+    a string, Node, {key: expr} template dict, or HostTemplateExpression."""
+    from ..models.template import HostTemplateExpression
+
     return (
         isinstance(g, tuple)
         and len(g) == 2
-        and isinstance(g[0], (str, Node))
+        and isinstance(g[0], (str, Node, dict, HostTemplateExpression))
         and (g[1] is None or isinstance(g[1], (np.ndarray, list)))
     )
 

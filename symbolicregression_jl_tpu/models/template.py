@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import inspect
+import re
 from types import SimpleNamespace
 from typing import Callable, Dict, NamedTuple, Optional, Sequence, Tuple
 
@@ -51,6 +52,7 @@ __all__ = [
     "eval_template_single",
     "eval_template_batch",
     "HostTemplateExpression",
+    "parse_template_expression",
 ]
 
 
@@ -407,6 +409,83 @@ def eval_template_batch(
     return y.reshape(*batch_shape, X.shape[1]), valid.reshape(batch_shape)
 
 
+def parse_template_expression(
+    s: str,
+    structure: TemplateStructure,
+    operators: OperatorSet,
+) -> "HostTemplateExpression":
+    """Parse the template string format back into a host expression
+    (round trip of :meth:`HostTemplateExpression.string`; the analogue
+    of the reference's '#N'-placeholder parse_expression,
+    /root/reference/src/TemplateExpression.jl:1014+).
+
+    Format: ``f = <expr over #1..#k>; g = <expr>; p = [v1, v2]`` —
+    components separated by ``; `` (or newlines), subexpression
+    arguments written ``#i``.
+    """
+    from ..ops.tree import parse_expression
+
+    trees: Dict[str, object] = {}
+    params = (
+        np.zeros((structure.total_params,), np.float64)
+        if structure.has_params else None
+    )
+    seen_params: set = set()
+    parts = [p.strip() for p in s.replace("\n", ";").split(";") if p.strip()]
+    for part in parts:
+        if "=" not in part:
+            raise ValueError(f"Template component missing '=': {part!r}")
+        name, rhs = part.split("=", 1)
+        name = name.strip().lstrip("╭├╰ ").strip()
+        rhs = rhs.strip()
+        if name in structure.expr_keys:
+            k = structure.expr_keys.index(name)
+            nf = structure.num_features[k]
+            names = [f"x{i + 1}" for i in range(max(nf, 1))]
+            # '#i' argument slots -> parser-friendly identifiers
+            rhs_sub = re.sub(r"#(\d+)", r"x\1", rhs)
+            trees[name] = parse_expression(
+                rhs_sub, operators, variable_names=names
+            )
+        elif name in structure.param_keys:
+            if not (rhs.startswith("[") and rhs.endswith("]")):
+                raise ValueError(f"Parameter vector {name!r} must be [..]")
+            vals = [float(v) for v in rhs[1:-1].split(",") if v.strip()]
+            i = structure.param_keys.index(name)
+            off = structure.param_offsets[i]
+            cnt = structure.num_params[i]
+            if len(vals) != cnt:
+                raise ValueError(
+                    f"Parameter {name!r} expects {cnt} values; got {len(vals)}"
+                )
+            params[off:off + cnt] = vals
+            seen_params.add(name)
+        else:
+            raise ValueError(
+                f"Unknown template component {name!r} (expressions: "
+                f"{structure.expr_keys}, parameters: {structure.param_keys})"
+            )
+    missing = [k for k in structure.expr_keys if k not in trees]
+    if missing:
+        raise ValueError(f"Template string missing subexpressions: {missing}")
+    if structure.has_params:
+        if not seen_params:
+            # No parameter components at all: leave params unset so the
+            # seeding path draws fresh randn banks instead of silently
+            # zeroing every parameter.
+            params = None
+        else:
+            missing_p = [k for k in structure.param_keys if k not in seen_params]
+            if missing_p:
+                raise ValueError(
+                    f"Template string sets {sorted(seen_params)} but is "
+                    f"missing parameter vectors: {missing_p}"
+                )
+    return HostTemplateExpression(
+        trees=trees, structure=structure, operators=operators, params=params
+    )
+
+
 # ---------------------------------------------------------------------------
 # Host-side expression (printing / export / prediction bookkeeping)
 # ---------------------------------------------------------------------------
@@ -451,6 +530,16 @@ class HostTemplateExpression:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"HostTemplateExpression({self.string()})"
+
+    def encode(self, max_nodes: int, dtype=np.float32):
+        """Postfix-encode into a [K, max_nodes] TreeBatch (member layout)."""
+        from ..ops.encoding import encode_population
+
+        enc = encode_population(
+            [self.trees[k] for k in self.structure.expr_keys],
+            max_nodes, self.operators, dtype=dtype,
+        )
+        return enc
 
     def __call__(self, X: np.ndarray) -> np.ndarray:
         """Evaluate on host data X [n, F]; invalid => NaN

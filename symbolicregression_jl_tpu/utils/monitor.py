@@ -1,0 +1,55 @@
+"""Resource monitor: host-overhead fraction of the search loop.
+
+TPU analogue of the reference's ResourceMonitor
+(/root/reference/src/SearchUtils.jl:411-438): the reference estimates
+head-node occupancy from the fraction of worker polls that found results
+waiting; in the synchronous SPMD design the analogous quantity is the
+fraction of wall time the host spends *outside* the device iteration
+(HoF decode, CSV/checkpoint writes, logging). A high fraction means the
+host bookkeeping — not the TPU — is pacing the search, mirroring the
+reference's "head node occupied" warning (:485-489).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Tuple
+
+__all__ = ["ResourceMonitor"]
+
+
+class ResourceMonitor:
+    """Sliding-window tracker of device vs host time per iteration."""
+
+    def __init__(self, window: int = 20, warn_fraction: float = 0.2):
+        self.samples: Deque[Tuple[float, float]] = deque(maxlen=window)
+        self.warn_fraction = warn_fraction
+        self._warned = False
+
+    def record(self, device_seconds: float, host_seconds: float) -> None:
+        self.samples.append((float(device_seconds), float(host_seconds)))
+
+    def estimate_work_fraction(self) -> float:
+        """Fraction of loop time spent on host bookkeeping
+        (estimate_work_fraction, src/SearchUtils.jl:432-438)."""
+        dev = sum(d for d, _ in self.samples)
+        host = sum(h for _, h in self.samples)
+        total = dev + host
+        return host / total if total > 0 else 0.0
+
+    def check_and_warn(self, verbosity: int = 1) -> bool:
+        """One-shot warning when host overhead paces the search
+        (the reference warns at 10s head occupancy estimates >= ~0.X)."""
+        if self._warned or len(self.samples) < self.samples.maxlen:
+            return False
+        frac = self.estimate_work_fraction()
+        if frac > self.warn_fraction:
+            self._warned = True
+            if verbosity >= 1:
+                print(
+                    f"Warning: host bookkeeping is {frac:.0%} of loop time "
+                    "— consider raising checkpoint_every_n / log_every_n or "
+                    "reducing verbosity."
+                )
+            return True
+        return False

@@ -83,8 +83,8 @@ class Recorder:
                     {
                         "complexity": int(e.complexity),
                         "loss": _sanitize(float(e.loss)),
-                        "equation": string_tree(
-                            e.tree, variable_names=variable_names
+                        "equation": e.equation_string(
+                            variable_names=variable_names
                         ),
                     }
                     for e in hof.entries

@@ -61,3 +61,23 @@ def test_progress_bar_smoke(tmp_path, capsys):
         X, y, options=options, niterations=1, verbosity=0, progress=True,
         seed=0,
     )
+
+
+def test_resource_monitor_fraction_and_warning(capsys):
+    """ResourceMonitor analogue (src/SearchUtils.jl:411-438): host
+    fraction estimate and the one-shot pacing warning."""
+    from symbolicregression_jl_tpu.utils.monitor import ResourceMonitor
+
+    m = ResourceMonitor(window=4, warn_fraction=0.2)
+    for _ in range(4):
+        m.record(device_seconds=1.0, host_seconds=1.0)
+    assert abs(m.estimate_work_fraction() - 0.5) < 1e-9
+    assert m.check_and_warn(verbosity=1)
+    assert "host bookkeeping" in capsys.readouterr().out
+    # one-shot: does not warn twice
+    assert not m.check_and_warn(verbosity=1)
+
+    fast = ResourceMonitor(window=2, warn_fraction=0.2)
+    fast.record(1.0, 0.01)
+    fast.record(1.0, 0.01)
+    assert not fast.check_and_warn(verbosity=0)

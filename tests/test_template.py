@@ -289,3 +289,96 @@ def test_template_hof_string_and_spec_validation(ops):
         )
     with pytest.raises(ValueError, match="TemplateStructure"):
         TemplateExpressionSpec(structure="not a structure")
+
+
+def test_parse_template_expression_roundtrip(ops):
+    from symbolicregression_jl_tpu.models.template import (
+        HostTemplateExpression,
+        parse_template_expression,
+    )
+
+    spec = template_spec(expressions=("f", "g"), parameters={"p": 2})(
+        lambda f, g, x1, x2, x3, p: f(x1, x2) + g(x3) * p[0] + p[1]
+    )
+    st = spec.structure
+    s = "f = #1 * #2 + 0.5; g = cos(#1); p = [2, -1.5]"
+    h = parse_template_expression(s, st, ops)
+    assert isinstance(h, HostTemplateExpression)
+    np.testing.assert_allclose(h.params, [2.0, -1.5])
+    # round trip through string()
+    h2 = parse_template_expression(h.string(), st, ops)
+    assert h2.string() == h.string()
+    # evaluation matches the structure semantics
+    X = np.random.default_rng(0).normal(size=(20, 3)).astype(np.float32)
+    pred = h(X)
+    expect = (X[:, 0] * X[:, 1] + 0.5) + np.cos(X[:, 2]) * 2.0 - 1.5
+    np.testing.assert_allclose(pred, expect, rtol=1e-5)
+
+
+def test_template_guess_seeding_injects_solution():
+    spec = template_spec(expressions=("f", "g"))(
+        lambda f, g, x1, x2, x3: f(x1, x2) + g(x3)
+    )
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (200, 3)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 2.0 * np.cos(X[:, 2])).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        maxsize=14,
+        populations=2,
+        population_size=12,
+        tournament_selection_n=4,
+        ncycles_per_iteration=2,
+        expression_spec=spec,
+        save_to_file=False,
+    )
+    # The exact law as a guess: one iteration must lock onto it.
+    hof = equation_search(
+        X, y, options=options, niterations=1, seed=0, verbosity=0,
+        guesses=["f = #1 * #2; g = cos(#1) + cos(#1)"],
+    )
+    best = min(e.loss for e in hof.entries)
+    assert best < 1e-10, f"seeded exact law lost (loss={best})"
+
+
+def test_parse_template_params_omitted_or_partial(ops):
+    from symbolicregression_jl_tpu.models.template import (
+        parse_template_expression,
+    )
+
+    spec = template_spec(expressions=("f",), parameters={"p": 2, "q": 1})(
+        lambda f, x1, p, q: f(x1) * p[0] + p[1] + q[0]
+    )
+    st = spec.structure
+    # no parameter components at all -> params stays unset (randn seeding)
+    h = parse_template_expression("f = #1 + 1", st, ops)
+    assert h.params is None
+    # partial parameter components -> explicit error
+    with pytest.raises(ValueError, match="missing parameter"):
+        parse_template_expression("f = #1; p = [1, 2]", st, ops)
+
+
+def test_template_dict_guess_with_params_and_validation():
+    spec = template_spec(expressions=("f",), parameters={"p": 1})(
+        lambda f, x1, x2, p: f(x1) + p[0] * x2
+    )
+    rng = np.random.default_rng(1)
+    X = rng.uniform(-2, 2, (100, 2)).astype(np.float32)
+    y = (X[:, 0] ** 2 + 3.0 * X[:, 1]).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "-", "*"], unary_operators=[],
+        maxsize=8, populations=2, population_size=10,
+        tournament_selection_n=4, ncycles_per_iteration=2,
+        expression_spec=spec, save_to_file=False,
+    )
+    hof = equation_search(
+        X, y, options=options, niterations=1, seed=0, verbosity=0,
+        guesses=[{"f": "#1 * #1", "p": [3.0]}],
+    )
+    assert min(e.loss for e in hof.entries) < 1e-8
+    with pytest.raises(ValueError, match="missing subexpressions"):
+        equation_search(
+            X, y, options=options, niterations=1, verbosity=0,
+            guesses=[{"p": [3.0]}],
+        )
