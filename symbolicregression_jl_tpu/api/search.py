@@ -178,9 +178,12 @@ def _encode_template_seeds(
     from ..models.template import (
         HostTemplateExpression,
         parse_template_expression,
+        template_from_dict,
     )
 
     st = engine.template
+    if not items:
+        return None, []
     encs, params = [], []
     for expr, gp in items:
         if isinstance(expr, HostTemplateExpression):
@@ -188,51 +191,7 @@ def _encode_template_seeds(
         elif isinstance(expr, str):
             h = parse_template_expression(expr, st, operators)
         elif isinstance(expr, dict):
-            missing = [k for k in st.expr_keys if k not in expr]
-            if missing:
-                raise ValueError(
-                    f"Template guess dict missing subexpressions: {missing} "
-                    f"(keys: {st.expr_keys})"
-                )
-            unknown = [
-                k for k in expr
-                if k not in st.expr_keys and k not in st.param_keys
-            ]
-            if unknown:
-                raise ValueError(
-                    f"Template guess dict has unknown keys: {unknown} "
-                    f"(expressions: {st.expr_keys}, parameters: {st.param_keys})"
-                )
-            trees = {}
-            for k, key in enumerate(st.expr_keys):
-                v = expr[key]
-                names = [f"x{i + 1}" for i in range(max(st.num_features[k], 1))]
-                trees[key] = (
-                    v if isinstance(v, Node)
-                    else parse_expression(str(v).replace("#", "x"), operators,
-                                          variable_names=names)
-                )
-            # Parameter vectors may ride the dict under their own keys.
-            h_params = None
-            if st.has_params and any(k in expr for k in st.param_keys):
-                missing_p = [k for k in st.param_keys if k not in expr]
-                if missing_p:
-                    raise ValueError(
-                        f"Template guess dict sets some parameter vectors "
-                        f"but is missing: {missing_p}"
-                    )
-                h_params = np.concatenate([
-                    np.asarray(expr[k], np.float64).reshape(-1)
-                    for k in st.param_keys
-                ])
-                if h_params.shape[0] != st.total_params:
-                    raise ValueError(
-                        f"Template guess parameters have "
-                        f"{h_params.shape[0]} values; expected "
-                        f"{st.total_params}"
-                    )
-            h = HostTemplateExpression(trees=trees, structure=st,
-                                       operators=operators, params=h_params)
+            h = template_from_dict(expr, st, operators)
         else:
             raise TypeError(
                 f"Template guess must be a template string, dict, or "
@@ -479,7 +438,9 @@ def equation_search(
     )
 
     out_dir = None
-    if options.save_to_file:
+    # Multi-host: only rank 0 writes CSVs/checkpoints (every host runs
+    # the same program and would race on the same files).
+    if options.save_to_file and jax.process_index() == 0:
         base = options.output_directory or (
             "outputs" if not os.environ.get("SYMBOLIC_REGRESSION_IS_TESTING")
             else os.path.join(os.environ.get("TMPDIR", "/tmp"), "sr_outputs")

@@ -53,6 +53,7 @@ __all__ = [
     "eval_template_batch",
     "HostTemplateExpression",
     "parse_template_expression",
+    "template_from_dict",
 ]
 
 
@@ -481,6 +482,64 @@ def parse_template_expression(
                     f"Template string sets {sorted(seen_params)} but is "
                     f"missing parameter vectors: {missing_p}"
                 )
+    return HostTemplateExpression(
+        trees=trees, structure=structure, operators=operators, params=params
+    )
+
+
+def template_from_dict(
+    d: Dict,
+    structure: TemplateStructure,
+    operators: OperatorSet,
+) -> "HostTemplateExpression":
+    """Build a host template expression from ``{key: expr}`` (+ optional
+    parameter-vector entries under their own keys) — the dict analogue of
+    :func:`parse_template_expression`, sharing its '#i' placeholder
+    grammar and validation."""
+    from ..ops.tree import Node, parse_expression
+
+    missing = [k for k in structure.expr_keys if k not in d]
+    if missing:
+        raise ValueError(
+            f"Template guess dict missing subexpressions: {missing} "
+            f"(keys: {structure.expr_keys})"
+        )
+    unknown = [
+        k for k in d
+        if k not in structure.expr_keys and k not in structure.param_keys
+    ]
+    if unknown:
+        raise ValueError(
+            f"Template guess dict has unknown keys: {unknown} (expressions: "
+            f"{structure.expr_keys}, parameters: {structure.param_keys})"
+        )
+    trees: Dict[str, object] = {}
+    for k, key in enumerate(structure.expr_keys):
+        v = d[key]
+        if isinstance(v, Node):
+            trees[key] = v
+            continue
+        names = [f"x{i + 1}" for i in range(max(structure.num_features[k], 1))]
+        trees[key] = parse_expression(
+            re.sub(r"#(\d+)", r"x\1", str(v)), operators, variable_names=names
+        )
+    params = None
+    if structure.has_params and any(k in d for k in structure.param_keys):
+        missing_p = [k for k in structure.param_keys if k not in d]
+        if missing_p:
+            raise ValueError(
+                f"Template guess dict sets some parameter vectors but is "
+                f"missing: {missing_p}"
+            )
+        params = np.concatenate([
+            np.asarray(d[k], np.float64).reshape(-1)
+            for k in structure.param_keys
+        ])
+        if params.shape[0] != structure.total_params:
+            raise ValueError(
+                f"Template guess parameters have {params.shape[0]} values; "
+                f"expected {structure.total_params}"
+            )
     return HostTemplateExpression(
         trees=trees, structure=structure, operators=operators, params=params
     )

@@ -435,34 +435,38 @@ def _make_grad_kernel(
                 x1 = buf_ref[c1, :]
                 x2 = buf_ref[c2, :]
 
-                zero = jnp.zeros_like(ct)
-                if unary_fns:
-                    if len(unary_fns) == 1:
-                        du = _vjp_unary(unary_fns[0], x1, ct)
-                    else:
-                        du = jax.lax.switch(
-                            o, [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
-                                for f in unary_fns], x1, ct)
-                else:
-                    du = zero
-                if binary_fns:
-                    if len(binary_fns) == 1:
-                        db1, db2 = _vjp_binary(binary_fns[0], x1, x2, ct)
-                    else:
-                        db1, db2 = jax.lax.switch(
-                            o, [lambda xx, yy, cc, f=f: _vjp_binary(f, xx, yy, cc)
-                                for f in binary_fns], x1, x2, ct)
-                else:
-                    db1, db2 = zero, zero
-                dx = jnp.where(a == 1, du, jnp.where(a == 2, db1, zero))
-                dy = jnp.where(a == 2, db2, zero)
-                # Padded rows carry zero cotangents but arbitrary (zero)
+                # Gate each arity's vjp behind pl.when: a scalar branch
+                # per slot skips the other arity's derivative entirely
+                # (computing both and selecting doubled the backward
+                # cost). Padded rows carry zero cotangents but arbitrary
                 # operand values, so op vjps can produce 0/0 = NaN there;
-                # mask every step or one NaN poisons the row sums.
-                dx = jnp.where(mask_row, dx, 0.0)
-                dy = jnp.where(mask_row, dy, 0.0)
-                adj_ref[c1, :] = adj_ref[c1, :] + dx
-                adj_ref[c2, :] = adj_ref[c2, :] + dy
+                # mask before accumulating or one NaN poisons the sums.
+                if unary_fns:
+                    @pl.when(a == 1)
+                    def _():
+                        if len(unary_fns) == 1:
+                            du = _vjp_unary(unary_fns[0], x1, ct)
+                        else:
+                            du = jax.lax.switch(
+                                o, [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
+                                    for f in unary_fns], x1, ct)
+                        du = jnp.where(mask_row, du, 0.0)
+                        adj_ref[c1, :] = adj_ref[c1, :] + du
+
+                if binary_fns:
+                    @pl.when(a == 2)
+                    def _():
+                        if len(binary_fns) == 1:
+                            db1, db2 = _vjp_binary(binary_fns[0], x1, x2, ct)
+                        else:
+                            db1, db2 = jax.lax.switch(
+                                o, [lambda xx, yy, cc, f=f:
+                                    _vjp_binary(f, xx, yy, cc)
+                                    for f in binary_fns], x1, x2, ct)
+                        db1 = jnp.where(mask_row, db1, 0.0)
+                        db2 = jnp.where(mask_row, db2, 0.0)
+                        adj_ref[c1, :] = adj_ref[c1, :] + db1
+                        adj_ref[c2, :] = adj_ref[c2, :] + db2
                 return 0
 
             jax.lax.fori_loop(0, root + 1, bwd, 0)

@@ -1,0 +1,16 @@
+"""Device-mesh placement and multi-host execution (the reference's
+Distributed.jl runtime re-imagined as single-program SPMD, SURVEY.md
+§2.4/§5.8)."""
+
+from .mesh import make_mesh, replicated, shard_device_data, shard_search_state
+from .multihost import initialize_multihost, is_multihost, process_index
+
+__all__ = [
+    "make_mesh",
+    "replicated",
+    "shard_device_data",
+    "shard_search_state",
+    "initialize_multihost",
+    "is_multihost",
+    "process_index",
+]

@@ -1,0 +1,112 @@
+"""Multi-host (DCN) execution: the Distributed.jl-cluster analogue.
+
+The reference scales past one machine with Distributed.jl workers over
+TCP — addprocs/Slurm integration, module import on workers, and
+user-function shipping (/root/reference/src/Configure.jl:253-360,
+docs/src/slurm.md). The TPU-native design needs none of that machinery:
+the search is one SPMD program, so multi-host is the *same* jitted
+iteration compiled over a larger mesh — islands sharded across all
+hosts' devices, migration/HoF collectives riding ICI within a slice and
+DCN across slices (SURVEY.md §5.8). Closures compile into the program,
+so "shipping user functions" (custom operators, template combiners,
+losses) is automatic.
+
+Usage, one call per host before building the search::
+
+    from symbolicregression_jl_tpu.parallel import initialize_multihost
+    initialize_multihost()          # TPU pods: auto-detected
+    # or explicitly, e.g. on GPU/CPU clusters:
+    initialize_multihost(coordinator_address="10.0.0.1:1234",
+                         num_processes=4, process_id=rank)
+
+    hof = equation_search(X, y, options=options)   # unchanged
+
+Every host must run the same program with the same data (the dataset is
+replicated — or row-sharded over the mesh's data axis with
+``RuntimeOptions(n_data_shards=...)``). `jax.devices()` then reports the
+global device set and the island mesh spans all of them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+__all__ = ["initialize_multihost", "is_multihost", "process_index"]
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[list] = None,
+) -> None:
+    """Join this process to the multi-host run (jax.distributed wrapper).
+
+    Must be the FIRST JAX interaction in the process — any call that
+    touches devices (even ``jax.devices()``) initializes the local XLA
+    backend and makes joining impossible. On TPU pods all arguments are
+    auto-detected from the environment; elsewhere pass the coordinator's
+    ``host:port``, the total process count, and this process's rank.
+    Idempotent when already initialized; a quiet no-op on a single host
+    with no cluster arguments/environment.
+    """
+    if jax.distributed.is_initialized():
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    except (ValueError, RuntimeError) as e:
+        msg = str(e)
+        no_args = coordinator_address is None and num_processes is None
+        if no_args and not _cluster_env_present():
+            # No cluster arguments and no cluster environment: plain
+            # single-host run — nothing to join, whatever the error.
+            return
+        if "before any JAX" in msg or "backend" in msg.lower():
+            # The backend is already up: joining can never succeed now —
+            # never swallow this on a real cluster, or a pod run silently
+            # degrades into N disconnected single-host searches racing on
+            # the same outputs.
+            raise RuntimeError(
+                "initialize_multihost must run before any other JAX call "
+                "in this process (the XLA backend is already initialized). "
+                "Call it at the very top of your program."
+            ) from e
+        raise RuntimeError(
+            f"Multi-host initialization failed: {e}. Every host must call "
+            "initialize_multihost with the same coordinator_address and "
+            "num_processes, and a distinct process_id."
+        ) from e
+
+
+def _cluster_env_present() -> bool:
+    """Heuristic for auto-detectable MULTI-host environments (TPU pod /
+    Slurm / Open MPI) — the ones jax.distributed.initialize() can join
+    without explicit arguments. Single-worker values (e.g.
+    ``TPU_WORKER_HOSTNAMES=localhost`` on a lone chip) don't count."""
+    import os
+
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h.strip()]) > 1:
+        return True
+    ntasks = os.environ.get("SLURM_NTASKS") or os.environ.get(
+        "OMPI_COMM_WORLD_SIZE"
+    )
+    if ntasks and int(ntasks) > 1:
+        return True
+    return "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    """This host's rank (0 = the host that should write outputs/CSVs)."""
+    return jax.process_index()

@@ -205,3 +205,17 @@ def test_entry_compiles():
     out = jax.jit(fn)(*args)
     assert out.shape == (256,)
     assert bool(np.isfinite(np.asarray(out)).any())
+
+
+def test_multihost_helpers_single_host():
+    """initialize_multihost is an idempotent no-op on a single host
+    (the SPMD design needs no worker bring-up — SURVEY.md §5.8)."""
+    from symbolicregression_jl_tpu.parallel import (
+        initialize_multihost,
+        is_multihost,
+        process_index,
+    )
+
+    initialize_multihost()  # no cluster env: returns quietly
+    assert not is_multihost()
+    assert process_index() == 0
