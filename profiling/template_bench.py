@@ -59,11 +59,13 @@ def main():
         sr.search_key(0), T, st, engine.cfg.mctx, jnp.float32
     )
 
+    fused = jax.default_backend() == "tpu"
+
     @jax.jit
     def prog(tr):
         def body(c, _):
             yv, valid = eval_template_batch(tr, ds.data.Xt, st,
-                                            options.operators)
+                                            options.operators, fused=fused)
             return c + jnp.sum(jnp.where(valid, yv[:, 0], 0.0)), None
         out, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=10)
         return out

@@ -278,19 +278,30 @@ def optimize_constants_template(
     template,                  # models.template.TemplateStructure
     batch_idx: Optional[jax.Array] = None,
     params: Optional[jax.Array] = None,   # [P, total_params, 1]
+    fused: bool = False,
+    interpret: bool = False,
 ):
     """Joint optimization of every subexpression's constants plus the
     template parameter vectors as one flat vector per member
     (get_scalar_constants for TemplateExpression includes parameters,
     /root/reference/src/TemplateExpression.jl:411-448).
 
+    Structured like `optimize_constants_fused`: members × restarts ride
+    one batch axis, each L-BFGS step is ONE batched template eval for
+    the gradient (through `fused_predict_ad`'s cotangent-seeded backward
+    kernel when ``fused``) and ONE for all line-search candidates — no
+    per-member interpreter buffers.
+
     Returns (new_const [P, K, L], improved [P], new_loss [P],
     f_calls [P], new_params [P, total_params, 1]).
     """
-    from ..models.template import eval_template_single
+    from ..models.template import eval_template_batch
 
     P, K, L = trees.arity.shape
     T = template.total_params
+    R = cfg.nrestarts + 1
+    C = cfg.max_linesearch
+    D = K * L + T
     if batch_idx is None:
         X, y, w = data.Xt, data.y, data.weights
     else:
@@ -298,56 +309,139 @@ def optimize_constants_template(
         y = jnp.take(data.y, batch_idx)
         w = None if data.weights is None else jnp.take(data.weights, batch_idx)
 
-    def member_fn(k, arity, op, feat, const0, length, active, p0):
-        # arity.. [K, L]; p0 [T]
-        cmask = (
-            (jnp.arange(L)[None, :] < length[:, None])
-            & (arity == 0) & (op == LEAF_CONST)
-        )  # [K, L]
-        x0 = jnp.concatenate([const0.reshape(-1), p0])
-        mask = jnp.concatenate(
-            [cmask.reshape(-1), jnp.ones((T,), jnp.bool_)]
-        )
-
-        @jax.checkpoint
-        def f(x):
-            c = jnp.where(cmask, x[: K * L].reshape(K, L), const0)
-            member = TreeBatch(arity=arity, op=op, feat=feat, const=c,
-                               length=length)
-            pred, valid = eval_template_single(
-                member, X, template, operators,
-                params_flat=x[K * L:] if T else None,
-            )
-            return aggregate_loss(elementwise_loss, pred, y, valid, w)
-
-        baseline = f(x0)
-        eps = jax.random.normal(k, (cfg.nrestarts, K * L + T), x0.dtype)
-        starts = jnp.concatenate(
-            [x0[None], x0[None] * (1.0 + 0.5 * eps)], axis=0
-        )
-        xs, fs, calls = jax.vmap(
-            lambda x_init: _bfgs_minimize(f, x_init, mask, cfg)
-        )(starts)
-        best = jnp.argmin(jnp.where(jnp.isnan(fs), jnp.inf, fs))
-        x_best, f_best = xs[best], fs[best]
-        improved = active & (f_best < baseline) & jnp.isfinite(f_best)
-        new_const = jnp.where(
-            improved & cmask.reshape(-1), x_best[: K * L], const0.reshape(-1)
-        ).reshape(K, L)
-        new_p = jnp.where(improved, x_best[K * L:], p0)
-        return new_const, improved, jnp.where(improved, f_best, baseline), (
-            jnp.sum(calls) * active
-        ), new_p
-
-    keys = jax.random.split(key, P)
-    p_in = (
+    slot = jnp.arange(L)
+    cmask = (
+        (slot[None, None, :] < trees.length[..., None])
+        & (trees.arity == 0) & (trees.op == LEAF_CONST)
+    )  # [P, K, L]
+    xmask = jnp.concatenate(
+        [cmask.reshape(P, K * L), jnp.ones((P, T), jnp.bool_)], axis=1
+    )  # [P, D]
+    x0 = jnp.concatenate([
+        trees.const.reshape(P, K * L),
         params[..., 0] if (params is not None and T > 0)
-        else jnp.zeros((P, T), trees.const.dtype)
+        else jnp.zeros((P, T), trees.const.dtype),
+    ], axis=1)  # [P, D]
+
+    def rep(a, r):
+        return jnp.repeat(a, r, axis=0)
+
+    def loss_of(xb, reps):  # xb [P*reps, D] -> loss [P*reps]
+        m = xb.shape[0]
+        c = jnp.where(
+            rep(cmask, reps).reshape(m, K, L),
+            xb[:, : K * L].reshape(m, K, L),
+            rep(trees.const, reps),
+        )
+        member = TreeBatch(
+            arity=rep(trees.arity, reps), op=rep(trees.op, reps),
+            feat=rep(trees.feat, reps), const=c,
+            length=rep(trees.length, reps),
+        )
+        pred, valid = eval_template_batch(
+            member, X, template, operators,
+            params=xb[:, K * L:] if T else None,
+            fused=fused, interpret=interpret,
+        )
+        return aggregate_loss(elementwise_loss, pred, y, valid, w)
+
+    def vg(xb, reps):
+        # Remat: on the unfused path (CPU / turbo off) the interpreter's
+        # per-node residuals for the whole member×restart batch would
+        # otherwise live through the backward pass at once.
+        @jax.checkpoint
+        def total(xx):
+            loss = loss_of(xx, reps)
+            return jnp.sum(jnp.where(jnp.isfinite(loss), loss, 0.0)), loss
+
+        g, loss = jax.grad(total, has_aux=True)(xb)
+        g = jnp.where(rep(xmask, reps), g, 0.0)
+        g = jnp.where(jnp.isfinite(g), g, 0.0)
+        return loss, g
+
+    # members × restarts: x0 plus perturbed starts x0*(1+0.5 eps)
+    eps = jax.random.normal(key, (P, cfg.nrestarts, D), x0.dtype)
+    starts = jnp.concatenate(
+        [x0[:, None], x0[:, None] * (1.0 + 0.5 * eps)], axis=1
+    ).reshape(P * R, D)
+
+    fx0, g0 = vg(starts, R)
+    ts = cfg.shrink ** jnp.arange(C, dtype=x0.dtype)
+    M = P * R
+    hlen = min(int(cfg.iterations), 8)
+    S0 = jnp.zeros((hlen, M, D), x0.dtype)
+    Y0 = jnp.zeros((hlen, M, D), x0.dtype)
+    rho0 = jnp.zeros((hlen, M), x0.dtype)
+
+    def lbfgs_direction(g, S, Y, rho):
+        q = g
+        alphas = []
+        for i in range(hlen):
+            alpha = rho[i] * jnp.sum(S[i] * q, axis=1)
+            q = q - alpha[:, None] * Y[i]
+            alphas.append(alpha)
+        yy = jnp.sum(Y[0] * Y[0], axis=1)
+        sy = jnp.sum(S[0] * Y[0], axis=1)
+        gamma = jnp.where((rho[0] != 0) & (yy > 0),
+                          sy / jnp.maximum(yy, 1e-30), 1.0)
+        q = q * jnp.clip(gamma, 1e-8, 1e8)[:, None]
+        for i in reversed(range(hlen)):
+            beta = rho[i] * jnp.sum(Y[i] * q, axis=1)
+            q = q + (alphas[i] - beta)[:, None] * S[i]
+        return -q
+
+    def bfgs_iter(carry, _):
+        x, fx, g, S, Y, rho, calls = carry
+        d = lbfgs_direction(g, S, Y, rho)
+        dg = jnp.sum(d * g, axis=1)
+        use_sd = (dg >= 0) | ~jnp.all(jnp.isfinite(d), axis=1)
+        d = jnp.where(use_sd[:, None], -g, d)
+        dg = jnp.where(use_sd, -jnp.sum(g * g, axis=1), dg)
+
+        cand_x = x[:, None, :] + ts[None, :, None] * d[:, None, :]
+        f_cand = loss_of(cand_x.reshape(M * C, D), R * C).reshape(M, C)
+        armijo = (
+            f_cand <= fx[:, None] + cfg.c1 * ts[None, :] * dg[:, None]
+        ) & jnp.isfinite(f_cand)
+        any_ok = jnp.any(armijo, axis=1)
+        first = jnp.argmax(armijo, axis=1)
+        t_star = jnp.where(any_ok, ts[first], 0.0)
+        s = t_star[:, None] * d
+        x_new = x + s
+        f_new, g_new = vg(x_new, R)
+        x_new = jnp.where(any_ok[:, None], x_new, x)
+        f_new = jnp.where(any_ok, f_new, fx)
+        g_new = jnp.where(any_ok[:, None], g_new, g)
+        yv = g_new - g
+        sy = jnp.sum(s * yv, axis=1)
+        rho_new = jnp.where(jnp.abs(sy) > 1e-10, 1.0 / sy, 0.0)
+        S = jnp.concatenate([s[None], S[:-1]], axis=0)
+        Y = jnp.concatenate([yv[None], Y[:-1]], axis=0)
+        rho = jnp.concatenate([rho_new[None], rho[:-1]], axis=0)
+        return (x_new, f_new, g_new, S, Y, rho, calls + C + 1), None
+
+    calls0 = jnp.ones((M,), jnp.float32)
+    (xf, fxf, _, _, _, _, calls), _ = jax.lax.scan(
+        bfgs_iter, (starts, fx0, g0, S0, Y0, rho0, calls0), None,
+        length=cfg.iterations,
     )
-    new_const, improved, new_loss, f_calls, new_p = jax.vmap(member_fn)(
-        keys, trees.arity, trees.op, trees.feat, trees.const, trees.length,
-        do_opt, p_in,
+
+    baseline = fx0.reshape(P, R)[:, 0]
+    fxf = jnp.where(jnp.isnan(fxf), jnp.inf, fxf).reshape(P, R)
+    xs = xf.reshape(P, R, D)
+    best_r = jnp.argmin(fxf, axis=1)
+    f_best = jnp.take_along_axis(fxf, best_r[:, None], axis=1)[:, 0]
+    x_best = jnp.take_along_axis(xs, best_r[:, None, None], axis=1)[:, 0]
+    improved = do_opt & (f_best < baseline) & jnp.isfinite(f_best)
+    new_const = jnp.where(
+        improved[:, None] & cmask.reshape(P, K * L),
+        x_best[:, : K * L], trees.const.reshape(P, K * L),
+    ).reshape(P, K, L)
+    new_p = jnp.where(
+        improved[:, None], x_best[:, K * L:], x0[:, K * L:]
     )
+    new_loss = jnp.where(improved, f_best, baseline)
+    f_calls = jnp.sum(calls.reshape(P, R), axis=1) * do_opt
     new_params = (
         new_p[..., None] if params is not None
         else jnp.zeros((P, 0, 1), trees.const.dtype)

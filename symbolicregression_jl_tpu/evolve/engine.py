@@ -437,9 +437,12 @@ class Engine:
                 sel_marked = jnp.take_along_axis(opt_mark, sel_idx, axis=1)
                 gate = gate | sel_marked
 
-            if cfg.turbo:
+            if cfg.turbo and cfg.template is None:
                 # One flattened launch across all islands: the fused BFGS
                 # batches its line search through the Pallas kernel.
+                # (Templates always take the jnp branch below — their
+                # joint constant+parameter optimization differentiates
+                # through the combiner.)
                 sub = jax.vmap(
                     lambda t, i: jax.tree.map(
                         lambda x: jnp.take(x, i, axis=0), t
@@ -469,6 +472,7 @@ class Engine:
                             k, sub, g, data, el_loss, cfg.operators,
                             self.opt_cfg, cfg.template,
                             batch_idx=batch_idx, params=sub_p,
+                            fused=cfg.turbo, interpret=cfg.interpret,
                         )
                 else:
                     def island_opt(k, trees: TreeBatch, idx, g, p):

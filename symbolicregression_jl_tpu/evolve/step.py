@@ -117,10 +117,12 @@ def evolve_config_from_options(options: Options, nfeatures: int,
         turbo = False
     if options.loss_function is not None or options.loss_function_expression is not None:
         turbo = False  # custom whole-prediction losses use the jnp path
-    if n_params > 0:
+    if n_params > 0 and template is None:
         turbo = False  # parameter-leaf gather uses the jnp interpreter
-    if template is not None:
-        turbo = False  # combiner-driven eval uses the jnp interpreter
+    # (templates keep turbo: the batched template evaluator routes
+    # shared-argument call sites through the fused predict kernel, and
+    # the template constant optimizer's gradients go through
+    # fused_predict_ad's cotangent-seeded backward kernel)
     if n_data_shards > 1:
         # Documented fallback: `pl.pallas_call` does not compose with
         # GSPMD row-sharded operands (it would need a shard_map wrapper
@@ -393,7 +395,8 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
             else None
         )
         pred, valid = eval_template_batch(trees, X, template, operators,
-                                          params=t_params)
+                                          params=t_params,
+                                          fused=turbo, interpret=interpret)
         loss = _loss_from_pred(pred, valid)
         complexity = jnp.sum(compute_complexity_batch(trees, tables), axis=-1)
         cost = loss_to_cost(loss, data.baseline_loss, data.use_baseline,

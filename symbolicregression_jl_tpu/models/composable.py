@@ -76,7 +76,16 @@ def _is_vv(v) -> bool:
 
 
 def _all_finite(x) -> jax.Array:
-    return jnp.all(jnp.isfinite(x))
+    """Validity of an operation result: all-finite over the row axis.
+
+    Scalars/row vectors give a scalar flag (the per-member path);
+    member-batched data [M, n] gives a per-member flag [M] (the batched
+    template evaluator) — reduction is over the LAST axis only.
+    """
+    x = jnp.asarray(x)
+    if x.ndim == 0:
+        return jnp.isfinite(x)
+    return jnp.all(jnp.isfinite(x), axis=-1)
 
 
 def apply_operator(op: Union[str, Any], *args) -> ValidVector:

@@ -386,28 +386,179 @@ def eval_template_single(
     return y, valid
 
 
+class _BatchedTreeCallable:
+    """Member-batched subexpression callable: one call evaluates key k of
+    EVERY member in the batch over a shared (or per-member) argument set.
+
+    The combiner is traced once over these — the whole template forward
+    becomes a handful of batched tree-eval launches plus elementwise
+    ValidVector algebra, instead of a per-member vmap of the full
+    combiner. Dataset-column arguments (shared [n] rows) route through
+    the fused Pallas kernel; member-dependent arguments (outputs of
+    other subexpressions, [M, n]) fall back to the vmapped interpreter.
+    """
+
+    def __init__(self, key, trees: TreeBatch, child, arity_expected: int,
+                 operators, n: int, fused: bool, interpret: bool):
+        self.key = key
+        self.trees = trees           # fields [M, L]
+        self.child = child           # [M, L, A]
+        self.arity_expected = arity_expected
+        self.operators = operators
+        self.n = n
+        self.fused = fused
+        self.interpret = interpret
+
+    def __call__(self, *args):
+        if len(args) != self.arity_expected:
+            raise ValueError(
+                f"Subexpression {self.key!r} takes {self.arity_expected} "
+                f"arguments; got {len(args)}"
+            )
+        n = self.n
+        dtype = self.trees.const.dtype
+        valid_in = jnp.bool_(True)
+        rows = []
+        shared = True
+        for a in args:
+            if isinstance(a, ValidVector):
+                valid_in = valid_in & a.valid
+                x = jnp.asarray(a.x)
+            else:
+                x = jnp.asarray(a, dtype)
+            if x.ndim >= 2:
+                shared = False
+            rows.append(x)
+
+        tr = self.trees
+        if shared:
+            Xk = (
+                jnp.stack([jnp.broadcast_to(jnp.atleast_1d(r), (n,))
+                           for r in rows])
+                if rows else jnp.zeros((1, n), dtype)
+            )
+            if self.fused:
+                # _ad variant: constant gradients flow through a
+                # cotangent-seeded backward kernel, so jax.grad through
+                # the whole template eval works (constant optimization).
+                from ..ops.fused_eval import fused_predict_ad
+
+                pred, v = fused_predict_ad(
+                    tr, Xk.astype(dtype), self.operators,
+                    interpret=self.interpret,
+                )
+            else:
+                pred, v = jax.vmap(
+                    lambda a_, o_, f_, c_, l_, ch_: eval_single_tree(
+                        a_, o_, f_, c_, l_, ch_, Xk, self.operators
+                    )
+                )(tr.arity, tr.op, tr.feat, tr.const, tr.length, self.child)
+        else:
+            M = tr.arity.shape[0]
+            # Every argument broadcasts to [M, n]: shared rows [n],
+            # per-member rows [M, n], parameter columns [M, 1], scalars.
+            Xm = jnp.stack(
+                [jnp.broadcast_to(jnp.atleast_1d(r), (M, n)) for r in rows],
+                axis=1,
+            )  # [M, a, n]
+            pred, v = jax.vmap(
+                lambda a_, o_, f_, c_, l_, ch_, xm: eval_single_tree(
+                    a_, o_, f_, c_, l_, ch_, xm, self.operators
+                )
+            )(tr.arity, tr.op, tr.feat, tr.const, tr.length, self.child, Xm)
+        return ValidVector(pred, v & valid_in)
+
+
+class _BatchedParamVec:
+    """Member-batched ParamVec view: ``p[i]`` is a [M, 1] column (so it
+    broadcasts against both shared [n] rows and batched [M, n] data);
+    ValidVector indexing gathers per row -> [M, n] (per-member when the
+    index itself is member-batched)."""
+
+    def __init__(self, data: jax.Array):  # [M, cnt]
+        self.data = data
+
+    def __getitem__(self, idx):
+        if isinstance(idx, ValidVector):
+            ix = jnp.clip(idx.x.astype(jnp.int32), 0, self.data.shape[1] - 1)
+            if ix.ndim >= 2:  # member-dependent index [M, n]
+                g = jnp.take_along_axis(self.data, ix, axis=1)
+            else:             # shared index rows [n]
+                g = self.data[:, ix]
+            return ValidVector(g, idx.valid)
+        if isinstance(idx, int):
+            if not -len(self) <= idx < len(self):
+                raise IndexError(
+                    f"parameter index {idx} out of range [0, {len(self)})"
+                )
+            idx = idx % len(self)
+            return self.data[:, idx:idx + 1]
+        return self.data[:, idx]
+
+    def __len__(self):
+        return self.data.shape[1]
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+
 def eval_template_batch(
     trees: TreeBatch,            # [..., K, L]
     X: jax.Array,                # [F, n]
     structure: TemplateStructure,
     operators: OperatorSet,
     params: Optional[jax.Array] = None,   # [..., total_params]
+    fused: bool = False,
+    interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Batched template evaluation; returns (y[..., n], valid[...])."""
+    """Batched template evaluation; returns (y[..., n], valid[...]).
+
+    The combiner runs ONCE over member-batched callables (see
+    _BatchedTreeCallable) — with ``fused=True`` each shared-argument
+    call site is one fused Pallas launch over the whole member batch.
+    """
     K = structure.n_subexpressions
-    L = trees.max_nodes
     batch_shape = trees.arity.shape[:-2]
     flat = trees.reshape(-1, K)
-    T = structure.total_params
-    if T > 0:
-        p_flat = params.reshape(-1, T)
+    M = flat.length.shape[0]
+    n = X.shape[1]
+    child, _, _ = tree_structure_arrays(flat, need_depth=False)  # [M, K, L, A]
+
+    exprs = {}
+    for k, key in enumerate(structure.expr_keys):
+        sub = TreeBatch(
+            arity=flat.arity[:, k], op=flat.op[:, k], feat=flat.feat[:, k],
+            const=flat.const[:, k], length=flat.length[:, k],
+        )
+        exprs[key] = _BatchedTreeCallable(
+            key, sub, child[:, k], structure.num_features[k], operators, n,
+            fused, interpret,
+        )
+    xs = tuple(
+        ValidVector(X[i], jnp.bool_(True)) for i in range(structure.n_variables)
+    )
+    if structure.has_params:
+        if params is None:
+            raise ValueError("Template has parameters but none were provided")
+        p_flat = params.reshape(M, structure.total_params)
+        pns = SimpleNamespace(**{
+            key: _BatchedParamVec(
+                jax.lax.slice_in_dim(p_flat, off, off + cnt, axis=1)
+            )
+            for key, off, cnt in zip(
+                structure.param_keys, structure.param_offsets,
+                structure.num_params,
+            )
+        })
+        out = structure.combine(SimpleNamespace(**exprs), pns, xs)
     else:
-        p_flat = jnp.zeros((int(np.prod(batch_shape)) if batch_shape else 1, 0),
-                           trees.const.dtype)
-    y, valid = jax.vmap(
-        lambda t, p: eval_template_single(t, X, structure, operators, p)
-    )(flat, p_flat)
-    return y.reshape(*batch_shape, X.shape[1]), valid.reshape(batch_shape)
+        out = structure.combine(SimpleNamespace(**exprs), xs)
+    if not isinstance(out, ValidVector):
+        raise TemplateReturnError()
+    y = jnp.broadcast_to(jnp.atleast_2d(out.x), (M, n))
+    valid = jnp.broadcast_to(jnp.asarray(out.valid), (M,))
+    valid = valid & jnp.all(jnp.isfinite(y), axis=-1)
+    return y.reshape(*batch_shape, n), valid.reshape(batch_shape)
 
 
 def parse_template_expression(

@@ -33,11 +33,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .encoding import LEAF_CONST, LEAF_VAR, TreeBatch
+from .encoding import LEAF_CONST, LEAF_VAR, TreeBatch, tree_structure_arrays
 from .operators import OperatorSet
 
-__all__ = ["fused_loss", "fused_loss_and_const_grad", "stack_positions",
-           "supports_fused_eval"]
+__all__ = ["fused_loss", "fused_loss_and_const_grad", "fused_predict",
+           "fused_predict_ad", "stack_positions", "supports_fused_eval"]
 
 
 def stack_positions(arity: jax.Array) -> jax.Array:
@@ -305,6 +305,414 @@ def fused_loss(
     if batch_shape:
         return loss.reshape(batch_shape), valid.reshape(batch_shape)
     return loss[0], valid[0]
+
+
+# ---------------------------------------------------------------------------
+# Fused predictions: per-tree row outputs (no loss reduction)
+# ---------------------------------------------------------------------------
+#
+# Used by template expressions: each subexpression call site evaluates a
+# whole member-batch of subtrees over a shared argument matrix and needs
+# the raw predictions back for the combiner's ValidVector algebra
+# (models/template.py). Same VMEM-stack interpreter as `fused_loss`, but
+# the root rows stream out instead of folding into a loss scalar.
+
+
+def _make_predict_kernel(operators: OperatorSet, max_nodes: int,
+                         tree_block: int):
+    unary_fns = tuple(op.fn for op in operators.unary)
+    binary_fns = tuple(op.fn for op in operators.binary)
+
+    def kernel(
+        arity_ref,   # SMEM [TB, L]
+        op_ref,      # SMEM [TB, L]
+        feat_ref,    # SMEM [TB, L]
+        dst_ref,     # SMEM [TB, L]
+        length_ref,  # SMEM [TB, 1]
+        const_ref,   # SMEM [TB, L] f32
+        x_ref,       # VMEM [F, TILE]
+        mask_ref,    # VMEM [1, TILE] f32: 1.0 real rows
+        pred_ref,    # VMEM out [TB, TILE]
+        valid_ref,   # SMEM out [TB, 1] int32
+        stack_ref,   # VMEM scratch [TB, S, TILE]
+    ):
+        j = pl.program_id(1)
+        mask_row = mask_ref[0, :] > 0
+        tile = mask_row.shape[0]
+
+        for t in range(tree_block):
+            def body(k, vmask):
+                return _tree_kernel_body(
+                    t, k, arity_ref, op_ref, feat_ref, dst_ref, const_ref,
+                    x_ref, stack_ref, vmask,
+                    unary_fns, binary_fns,
+                )
+
+            vmask = jax.lax.fori_loop(
+                0, length_ref[t, 0], body,
+                jnp.ones((tile,), x_ref.dtype),
+            )
+            valid = jnp.all((vmask > 0) | jnp.logical_not(mask_row))
+            pred_ref[t, :] = stack_ref[t, 0, :]
+            partial_ok = jnp.int32(valid)
+
+            @pl.when(j == 0)
+            def _():
+                valid_ref[t, 0] = partial_ok
+
+            @pl.when(j != 0)
+            def _():
+                valid_ref[t, 0] = valid_ref[t, 0] & partial_ok
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("operators", "tree_block", "tile_rows", "interpret"),
+)
+def fused_predict(
+    trees: TreeBatch,
+    X: jax.Array,               # [F, n]
+    operators: OperatorSet,
+    *,
+    tree_block: int = 8,
+    tile_rows: int = 16384,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-tree predictions over all rows, fused on TPU.
+
+    Returns ``(pred[..., n], valid[...])`` with the TreeBatch's batch
+    dims; validity matches the interpreter (any non-finite node output
+    over the rows invalidates the tree).
+    """
+    batch_shape = trees.batch_shape
+    flat = trees.reshape(-1) if batch_shape else trees.reshape(1)
+    T = flat.length.shape[0]
+    L = flat.arity.shape[-1]
+    F, n = X.shape
+    dtype = X.dtype
+
+    TB = tree_block
+    S = L // 2 + 2
+    bytes_per = jnp.dtype(dtype).itemsize
+    TILE = _pick_tile(n, tile_rows, TB * S, bytes_per)
+    T_pad = _round_up(T, TB)
+    n_pad = _round_up(n, TILE)
+
+    def pad_trees(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
+                       constant_values=fill)
+
+    arity = pad_trees(flat.arity)
+    op = pad_trees(flat.op)
+    feat = jnp.clip(pad_trees(flat.feat), 0, F - 1)
+    const = pad_trees(flat.const).astype(dtype)
+    length = jnp.clip(pad_trees(flat.length.reshape(-1, 1), fill=1), 1, L)
+    dst = jnp.clip(stack_positions(arity), 0, S - 1)
+
+    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
+
+    grid = (T_pad // TB, n_pad // TILE)
+    kernel = _make_predict_kernel(operators, L, TB)
+
+    smem_i32 = lambda shape: pl.BlockSpec(
+        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
+    )
+
+    pred, valid = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            smem_i32((TB, L)),                       # arity
+            smem_i32((TB, L)),                       # op
+            smem_i32((TB, L)),                       # feat
+            smem_i32((TB, L)),                       # dst
+            smem_i32((TB, 1)),                       # length
+            pl.BlockSpec((TB, L), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),   # const
+            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),  # X
+            pl.BlockSpec((1, TILE), lambda i, j: (0, j)),  # mask
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, TILE), lambda i, j: (i, j)),
+            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_pad, n_pad), dtype),
+            jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((TB, S, TILE), dtype)],
+        interpret=interpret,
+    )(arity, op, feat, dst, length, const, Xp, maskp)
+
+    pred = pred[:T, :n]
+    valid = valid[:T, 0].astype(jnp.bool_)
+    if batch_shape:
+        return pred.reshape(*batch_shape, n), valid.reshape(batch_shape)
+    return pred[0], valid[0]
+
+
+# ---------------------------------------------------------------------------
+# fused_predict VJP: cotangent-seeded constant gradients
+# ---------------------------------------------------------------------------
+#
+# Differentiable prediction powers template constant optimization: the
+# combiner's elementwise algebra is differentiated by JAX as usual, and
+# each fused call site's backward contracts the incoming row cotangent
+# with the subtree's adjoint sweep in one kernel — no [M, L, n]
+# interpreter buffers. ``X`` is treated as constant data (zero
+# cotangent): fused call sites only ever receive dataset columns (the
+# batched template evaluator routes member-dependent arguments through
+# the jnp interpreter, which differentiates natively).
+
+
+def _make_predict_vjp_kernel(operators: OperatorSet, max_nodes: int,
+                             tree_block: int):
+    unary_fns = tuple(op.fn for op in operators.unary)
+    binary_fns = tuple(op.fn for op in operators.binary)
+    L = max_nodes
+
+    def kernel(
+        arity_ref,   # SMEM [TB, L]
+        op_ref,      # SMEM [TB, L]
+        feat_ref,    # SMEM [TB, L]
+        child1_ref,  # SMEM [TB, L]
+        child2_ref,  # SMEM [TB, L]
+        root_ref,    # SMEM [TB, 1]
+        const_ref,   # SMEM [TB, L] f32
+        cmask_ref,   # VMEM [TB, L] f32
+        x_ref,       # VMEM [F, TILE]
+        ct_ref,      # VMEM [TB, TILE] — incoming row cotangents
+        mask_ref,    # VMEM [1, TILE]
+        gconst_ref,  # VMEM out [TB, L]
+        buf_ref,     # VMEM scratch [L, TILE]
+        adj_ref,     # VMEM scratch [L, TILE]
+    ):
+        j = pl.program_id(1)
+        mask_row = mask_ref[0, :] > 0
+        tile = mask_ref.shape[-1]
+
+        for t in range(tree_block):
+            root = root_ref[t, 0]
+
+            def fwd(k, _):
+                a = arity_ref[t, k]
+                o = op_ref[t, k]
+
+                def leaf_val():
+                    x_row = x_ref[feat_ref[t, k], :]
+                    c = jnp.full((tile,), const_ref[t, k], dtype=x_ref.dtype)
+                    return jnp.where(o == LEAF_CONST, c, x_row)
+
+                def unary_val():
+                    child = buf_ref[child1_ref[t, k], :]
+                    if len(unary_fns) == 1:
+                        return unary_fns[0](child)
+                    return jax.lax.switch(o, unary_fns, child)
+
+                def binary_val():
+                    l = buf_ref[child1_ref[t, k], :]
+                    r = buf_ref[child2_ref[t, k], :]
+                    if len(binary_fns) == 1:
+                        return binary_fns[0](l, r)
+                    return jax.lax.switch(o, binary_fns, l, r)
+
+                branches = [leaf_val]
+                branches.append(unary_val if unary_fns else leaf_val)
+                branches.append(binary_val if binary_fns else leaf_val)
+                buf_ref[k, :] = jax.lax.switch(a, branches)
+                return 0
+
+            jax.lax.fori_loop(0, root + 1, fwd, 0)
+
+            adj_ref[...] = jnp.zeros((L, tile), dtype=x_ref.dtype)
+            adj_ref[root, :] = jnp.where(mask_row, ct_ref[t, :], 0.0)
+
+            def bwd(i, _):
+                k = root - i
+                a = arity_ref[t, k]
+                o = op_ref[t, k]
+                c1 = child1_ref[t, k]
+                c2 = child2_ref[t, k]
+                ct = adj_ref[k, :]
+                x1 = buf_ref[c1, :]
+                x2 = buf_ref[c2, :]
+
+                if unary_fns:
+                    @pl.when(a == 1)
+                    def _():
+                        if len(unary_fns) == 1:
+                            du = _vjp_unary(unary_fns[0], x1, ct)
+                        else:
+                            du = jax.lax.switch(
+                                o, [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
+                                    for f in unary_fns], x1, ct)
+                        du = jnp.where(mask_row, du, 0.0)
+                        adj_ref[c1, :] = adj_ref[c1, :] + du
+
+                if binary_fns:
+                    @pl.when(a == 2)
+                    def _():
+                        if len(binary_fns) == 1:
+                            db1, db2 = _vjp_binary(binary_fns[0], x1, x2, ct)
+                        else:
+                            db1, db2 = jax.lax.switch(
+                                o, [lambda xx, yy, cc, f=f:
+                                    _vjp_binary(f, xx, yy, cc)
+                                    for f in binary_fns], x1, x2, ct)
+                        db1 = jnp.where(mask_row, db1, 0.0)
+                        db2 = jnp.where(mask_row, db2, 0.0)
+                        adj_ref[c1, :] = adj_ref[c1, :] + db1
+                        adj_ref[c2, :] = adj_ref[c2, :] + db2
+                return 0
+
+            jax.lax.fori_loop(0, root + 1, bwd, 0)
+            grow = jnp.sum(adj_ref[...], axis=1) * cmask_ref[t, :]
+
+            @pl.when(j == 0)
+            def _():
+                gconst_ref[t, :] = grow
+
+            @pl.when(j != 0)
+            def _():
+                gconst_ref[t, :] = gconst_ref[t, :] + grow
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("operators", "tree_block", "tile_rows", "interpret"),
+)
+def _fused_predict_vjp(
+    trees: TreeBatch,           # [T, L] flat
+    X: jax.Array,               # [F, n]
+    ct: jax.Array,              # [T, n] row cotangents
+    operators: OperatorSet,
+    *,
+    tree_block: int = 8,
+    tile_rows: int = 16384,
+    interpret: bool = False,
+) -> jax.Array:
+    """d(sum(ct * pred)) / d(trees.const) — [T, L], zero off constant
+    leaves, non-finite contributions zeroed."""
+    T, L = trees.arity.shape
+    F, n = X.shape
+    dtype = X.dtype
+    child, _, _ = tree_structure_arrays(trees, need_depth=False)
+
+    TB = tree_block
+    bytes_per = jnp.dtype(dtype).itemsize
+    TILE = _pick_tile(n, tile_rows, 2 * L + TB, bytes_per)
+    T_pad = _round_up(T, TB)
+    n_pad = _round_up(n, TILE)
+
+    def pad_trees(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
+                       constant_values=fill)
+
+    arity = pad_trees(trees.arity)
+    op = pad_trees(trees.op)
+    feat = jnp.clip(pad_trees(trees.feat), 0, F - 1)
+    const = pad_trees(trees.const).astype(dtype)
+    child1 = jnp.clip(pad_trees(child[..., 0]), 0, L - 1)
+    child2 = jnp.clip(pad_trees(child[..., 1]), 0, L - 1)
+    root = jnp.clip(pad_trees(trees.length.reshape(-1, 1), fill=1) - 1, 0, L - 1)
+    slot = jnp.arange(L)
+    cmask = (
+        (slot[None, :] < trees.length[:, None])
+        & (trees.arity == 0)
+        & (trees.op == LEAF_CONST)
+    ).astype(dtype)
+    cmask = pad_trees(cmask)
+
+    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+    ctp = jnp.pad(ct.astype(dtype), ((0, T_pad - T), (0, n_pad - n)))
+    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
+
+    grid = (T_pad // TB, n_pad // TILE)
+    kernel = _make_predict_vjp_kernel(operators, L, TB)
+
+    smem_i32 = lambda shape: pl.BlockSpec(
+        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
+    )
+
+    (gconst,) = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            smem_i32((TB, L)),
+            smem_i32((TB, L)),
+            smem_i32((TB, L)),
+            smem_i32((TB, L)),
+            smem_i32((TB, L)),
+            smem_i32((TB, 1)),
+            pl.BlockSpec((TB, L), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((TB, L), lambda i, j: (i, 0)),       # cmask
+            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),     # X
+            pl.BlockSpec((TB, TILE), lambda i, j: (i, j)),    # ct
+            pl.BlockSpec((1, TILE), lambda i, j: (0, j)),     # mask
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, L), lambda i, j: (i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((T_pad, L), dtype)],
+        scratch_shapes=[
+            pltpu.VMEM((L, TILE), dtype),
+            pltpu.VMEM((L, TILE), dtype),
+        ],
+        interpret=interpret,
+    )(arity, op, feat, child1, child2, root, const, cmask, Xp, ctp, maskp)
+
+    gconst = gconst[:T]
+    return jnp.where(jnp.isfinite(gconst), gconst, 0.0)
+
+
+_PREDICT_AD_CACHE: dict = {}
+
+
+def fused_predict_ad(trees: TreeBatch, X: jax.Array, operators: OperatorSet,
+                     *, interpret: bool = False):
+    """`fused_predict` with a custom VJP w.r.t. the constant leaves.
+
+    Gradients flow into ``trees.const`` only; ``X`` and the structural
+    int fields get zero cotangents (fused template call sites receive
+    dataset columns, which are constants of the optimization).
+    Flat [T, L] trees only.
+    """
+    key = (operators, interpret)
+    if key not in _PREDICT_AD_CACHE:
+        def primal(arity, op, feat, const, length, X):
+            return fused_predict(
+                TreeBatch(arity, op, feat, const, length), X, operators,
+                interpret=interpret,
+            )
+
+        f = jax.custom_vjp(primal)
+
+        def fwd(arity, op, feat, const, length, X):
+            out = primal(arity, op, feat, const, length, X)
+            return out, (arity, op, feat, const, length, X)
+
+        def bwd(res, cts):
+            arity, op, feat, const, length, X = res
+            ct_pred, _ = cts  # valid output is boolean (float0 cotangent)
+            gconst = _fused_predict_vjp(
+                TreeBatch(arity, op, feat, const, length), X, ct_pred,
+                operators, interpret=interpret,
+            )
+            f0 = lambda x: np.zeros(x.shape, jax.dtypes.float0)
+            return (f0(arity), f0(op), f0(feat), gconst, f0(length),
+                    jnp.zeros_like(X))
+
+        f.defvjp(fwd, bwd)
+        _PREDICT_AD_CACHE[key] = f
+    f = _PREDICT_AD_CACHE[key]
+    return f(trees.arity, trees.op, trees.feat, trees.const, trees.length, X)
 
 
 # ---------------------------------------------------------------------------

@@ -382,3 +382,124 @@ def test_template_dict_guess_with_params_and_validation():
             X, y, options=options, niterations=1, verbosity=0,
             guesses=[{"p": [3.0]}],
         )
+
+
+def test_eval_template_batch_fused_matches_unfused(ops):
+    """The fused (Pallas) batched evaluator and the vmapped interpreter
+    path must agree, including validity."""
+    spec = template_spec(expressions=("f", "g"), parameters={"p": 1})(
+        lambda f, g, x1, x2, x3, p: f(x1, x2) + g(x3) * p[0]
+    )
+    st = spec.structure
+    exprs = [
+        parse_expression("x1 * x2 + 0.5", ops, variable_names=["x1", "x2"]),
+        parse_expression("cos(x1)", ops, variable_names=["x1"]),
+        parse_expression("x1 - x2", ops, variable_names=["x1", "x2"]),
+        parse_expression("1.0 / x1", ops, variable_names=["x1"]),  # invalid on 0
+    ]
+    enc = encode_population(exprs, 8, ops)
+    trees = TreeBatch(  # 2 members: [2, K=2, L]
+        arity=enc.arity.reshape(2, 2, -1), op=enc.op.reshape(2, 2, -1),
+        feat=enc.feat.reshape(2, 2, -1), const=enc.const.reshape(2, 2, -1),
+        length=enc.length.reshape(2, 2),
+    )
+    X = np.concatenate([
+        np.zeros((3, 1), np.float32),  # row with x=0 -> 1/x1 invalid
+        np.random.default_rng(0).normal(size=(3, 30)).astype(np.float32),
+    ], axis=1)
+    params = jnp.asarray([[2.0], [3.0]], jnp.float32)
+    y1, v1 = eval_template_batch(trees, jnp.asarray(X), st, ops, params,
+                                 fused=False)
+    y2, v2 = eval_template_batch(trees, jnp.asarray(X), st, ops, params,
+                                 fused=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    m = np.asarray(v1)
+    np.testing.assert_allclose(
+        np.asarray(y1)[m], np.asarray(y2)[m], rtol=1e-5
+    )
+    assert bool(v1[0]) and not bool(v1[1])
+
+
+def test_template_search_fused_path_runs():
+    """Force turbo on CPU (interpret kernels) through a short template
+    search to cover the fused engine path end-to-end."""
+    spec = template_spec(expressions=("f",))(lambda f, x1, x2: f(x1, x2))
+    rng = np.random.default_rng(2)
+    X = rng.uniform(-2, 2, (80, 2)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1]).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "*"], unary_operators=[],
+        maxsize=8, populations=2, population_size=10,
+        tournament_selection_n=4, ncycles_per_iteration=2,
+        expression_spec=spec, save_to_file=False, turbo=True,
+    )
+    hof = equation_search(X, y, options=options, niterations=2, seed=0,
+                          verbosity=0)
+    assert np.isfinite(min(e.loss for e in hof.entries))
+
+
+def test_batched_param_as_subexpression_argument(ops):
+    """p[i] may be passed INTO a subexpression (reference combiners do
+    this); the batched evaluator must broadcast the [M, 1] column."""
+    spec = template_spec(expressions=("f",), parameters={"p": 1})(
+        lambda f, x1, p: f(x1, p[0])
+    )
+    st = spec.structure
+    enc = encode_population(
+        [parse_expression("x1 * x2", ops, variable_names=["x1", "x2"])], 8, ops
+    )
+    trees = TreeBatch(
+        arity=enc.arity[None], op=enc.op[None], feat=enc.feat[None],
+        const=enc.const[None], length=enc.length[None],
+    )
+    X = np.random.default_rng(0).normal(size=(1, 25)).astype(np.float32)
+    params = jnp.asarray([[3.0]], jnp.float32)
+    for fused in (False, True):
+        y, valid = eval_template_batch(
+            trees, jnp.asarray(X), st, ops, params,
+            fused=fused, interpret=fused,
+        )
+        assert bool(valid[0])
+        np.testing.assert_allclose(np.asarray(y[0]), X[0] * 3.0, rtol=1e-5)
+
+
+def test_batched_param_member_dependent_gather(ops):
+    """p[idx] with a subexpression-produced index gathers per member."""
+    spec = template_spec(expressions=("f",), parameters={"p": 2})(
+        lambda f, x1, p: p[f(x1)]
+    )
+    st = spec.structure
+    enc = encode_population(
+        [parse_expression("x1", ops, variable_names=["x1"]),
+         parse_expression("x1 + 1.0", ops, variable_names=["x1"])], 8, ops
+    )
+    trees = TreeBatch(  # member 0: idx = x1; member 1: idx = x1 + 1
+        arity=enc.arity[:, None], op=enc.op[:, None], feat=enc.feat[:, None],
+        const=enc.const[:, None], length=enc.length[:, None],
+    )
+    X = np.asarray([[0.0, 1.0, 0.0, 1.0]], np.float32)
+    params = jnp.asarray([[10.0, 20.0], [30.0, 40.0]], jnp.float32)
+    y, valid = eval_template_batch(trees, jnp.asarray(X), st, ops, params)
+    np.testing.assert_allclose(np.asarray(y[0]), [10.0, 20.0, 10.0, 20.0])
+    np.testing.assert_allclose(np.asarray(y[1]), [40.0, 40.0, 40.0, 40.0])
+
+
+def test_batched_param_iteration_terminates(ops):
+    """`for v in p` must iterate len(p) elements (legacy sequence
+    iteration over a bounds-checked __getitem__ would loop forever
+    without __iter__)."""
+    spec = template_spec(expressions=("f",), parameters={"p": 3})(
+        lambda f, x1, p: f(x1) + sum(v for v in p)
+    )
+    st = spec.structure
+    enc = encode_population(
+        [parse_expression("x1", ops, variable_names=["x1"])], 8, ops
+    )
+    trees = TreeBatch(
+        arity=enc.arity[None], op=enc.op[None], feat=enc.feat[None],
+        const=enc.const[None], length=enc.length[None],
+    )
+    X = np.ones((1, 5), np.float32)
+    params = jnp.asarray([[1.0, 2.0, 3.0]], jnp.float32)
+    y, valid = eval_template_batch(trees, jnp.asarray(X), st, ops, params)
+    np.testing.assert_allclose(np.asarray(y[0]), np.full(5, 7.0), rtol=1e-6)
