@@ -351,26 +351,30 @@ class SRRegressor:
     def _export_tree(rec):
         if rec.tree is None:
             raise NotImplementedError(
-                "latex/sympy export is not supported for template "
-                "expressions — use the record's `.equation` string "
-                "(per-subexpression strings via .template_expr)"
+                "sympy export is not supported for template expressions — "
+                "use the record's `.equation` string (per-subexpression "
+                "strings via .template_expr) or .latex()"
             )
         return rec.tree
 
-    def latex(self, idx: Optional[int] = None) -> Union[str, List[str]]:
-        """LaTeX form of the selected equation(s)."""
-        from ..utils.export import to_latex
+    def _latex_one(self, rec) -> str:
+        from ..utils.export import template_to_latex, to_latex
 
+        if rec.template_expr is not None:
+            return template_to_latex(rec.template_expr)
+        return to_latex(rec.tree, variable_names=self.variable_names_)
+
+    def latex(self, idx: Optional[int] = None) -> Union[str, List[str]]:
+        """LaTeX form of the selected equation(s); template expressions
+        render as an aligned per-component block."""
         self._check_fitted()
         if self._MULTITARGET:
             return [
-                to_latex(self._export_tree(recs[i if idx is None else idx]),
-                         variable_names=self.variable_names_)
+                self._latex_one(recs[i if idx is None else idx])
                 for recs, i in zip(self.equations_, self.best_idx_)
             ]
         i = int(idx) if idx is not None else int(self.best_idx_)
-        return to_latex(self._export_tree(self.equations_[i]),
-                        variable_names=self.variable_names_)
+        return self._latex_one(self.equations_[i])
 
     def sympy(self, idx: Optional[int] = None):
         """SymPy expression of the selected equation (requires sympy)."""

@@ -131,12 +131,6 @@ class TemplateStructure(NamedTuple):
             o += n
         return tuple(offs)
 
-    def param_namespace(self, flat: jax.Array) -> SimpleNamespace:
-        """Views of a flat parameter bank as named ParamVecs."""
-        ns = {}
-        for k, off, n in zip(self.param_keys, self.param_offsets, self.num_params):
-            ns[k] = ParamVec(jax.lax.slice_in_dim(flat, off, off + n))
-        return SimpleNamespace(**ns)
 
 
 def make_template_structure(
@@ -303,48 +297,6 @@ def template_spec(
 # ---------------------------------------------------------------------------
 
 
-class _TreeCallable:
-    """Device callable over one subexpression's postfix tensors
-    (the jitted analogue of calling a ComposableExpression,
-    reference ComposableExpression.jl:198-227)."""
-
-    def __init__(self, key, fields, child, arity_expected: int, operators, n: int):
-        self.key = key
-        self.fields = fields  # (arity, op, feat, const, length) — [L] each
-        self.child = child
-        self.arity_expected = arity_expected
-        self.operators = operators
-        self.n = n
-
-    def __call__(self, *args):
-        if len(args) != self.arity_expected:
-            raise ValueError(
-                f"Subexpression {self.key!r} takes {self.arity_expected} "
-                f"arguments; got {len(args)}"
-            )
-        valid_in = jnp.bool_(True)
-        rows = []
-        for a in args:
-            if isinstance(a, ValidVector):
-                valid_in = valid_in & a.valid
-                rows.append(jnp.broadcast_to(jnp.atleast_1d(a.x), (self.n,)))
-            else:
-                rows.append(
-                    jnp.broadcast_to(jnp.asarray(a, self.fields[3].dtype),
-                                     (self.n,))
-                )
-        Xk = (
-            jnp.stack(rows)
-            if rows
-            else jnp.zeros((1, self.n), self.fields[3].dtype)
-        )
-        arity, op, feat, const, length = self.fields
-        y, v = eval_single_tree(
-            arity, op, feat, const, length, self.child, Xk, self.operators
-        )
-        return ValidVector(y, v & valid_in)
-
-
 def eval_template_single(
     trees: TreeBatch,            # [K, L]
     X: jax.Array,                # [F, n]
@@ -354,36 +306,16 @@ def eval_template_single(
 ) -> Tuple[jax.Array, jax.Array]:
     """Evaluate one template member over all rows; returns (y[n], valid).
 
-    Mirrors DE.eval_tree_array for TemplateExpression (reference
-    :684-711): wrap dataset rows in ValidVectors, hand the combiner
-    device callables for the subexpressions, demand a ValidVector back.
-    """
-    n = X.shape[1]
-    child, _, _ = tree_structure_arrays(trees, need_depth=False)  # [K, L, A]
-    exprs = {}
-    for k, key in enumerate(structure.expr_keys):
-        fields = (
-            trees.arity[k], trees.op[k], trees.feat[k], trees.const[k],
-            trees.length[k],
-        )
-        exprs[key] = _TreeCallable(
-            key, fields, child[k], structure.num_features[k], operators, n
-        )
-    xs = tuple(
-        ValidVector(X[i], jnp.bool_(True)) for i in range(structure.n_variables)
+    Thin M=1 wrapper over :func:`eval_template_batch` — one evaluator
+    implementation serves both shapes (the batched path is the
+    load-bearing one: search candidates, optimizer, prediction)."""
+    batched = TreeBatch(
+        arity=trees.arity[None], op=trees.op[None], feat=trees.feat[None],
+        const=trees.const[None], length=trees.length[None],
     )
-    if structure.has_params:
-        if params_flat is None:
-            raise ValueError("Template has parameters but none were provided")
-        pns = structure.param_namespace(params_flat)
-        out = structure.combine(SimpleNamespace(**exprs), pns, xs)
-    else:
-        out = structure.combine(SimpleNamespace(**exprs), xs)
-    if not isinstance(out, ValidVector):
-        raise TemplateReturnError()
-    y = jnp.broadcast_to(jnp.atleast_1d(out.x), (n,))
-    valid = out.valid & jnp.all(jnp.isfinite(y))
-    return y, valid
+    p = None if params_flat is None else params_flat[None]
+    y, valid = eval_template_batch(batched, X, structure, operators, params=p)
+    return y[0], valid[0]
 
 
 class _BatchedTreeCallable:

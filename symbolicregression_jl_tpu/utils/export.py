@@ -13,7 +13,7 @@ import numpy as np
 
 from ..ops.tree import Node
 
-__all__ = ["to_latex", "to_sympy", "to_callable"]
+__all__ = ["to_latex", "to_sympy", "to_callable", "template_to_latex"]
 
 
 _LATEX_UNARY = {
@@ -202,3 +202,26 @@ def to_callable(
         return go(tree)
 
     return f
+
+
+def template_to_latex(template_expr, precision: int = 4) -> str:
+    """LaTeX for a HostTemplateExpression: aligned per-component lines
+    (subexpression arguments render as ``\\#i``; parameter vectors as
+    row matrices)."""
+    st = template_expr.structure
+    lines = []
+    for k, key in enumerate(st.expr_keys):
+        names = [f"\\#{i + 1}" for i in range(st.num_features[k])]
+        body = to_latex(template_expr.trees[key], variable_names=names,
+                        precision=precision)
+        lines.append(f"{key} &= {body}")
+    if st.has_params and template_expr.params is not None:
+        for key, off, cnt in zip(st.param_keys, st.param_offsets,
+                                 st.num_params):
+            vals = ", ".join(
+                f"{float(v):.{precision}g}"
+                for v in template_expr.params[off:off + cnt]
+            )
+            lines.append(f"{key} &= [{vals}]")
+    sep = " \\\\\n"
+    return "\\begin{aligned}\n" + sep.join(lines) + "\n\\end{aligned}"
