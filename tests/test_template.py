@@ -567,3 +567,19 @@ def test_fused_template_gradients_match_interpreter(ops):
                                rtol=1e-3, atol=1e-4)
     # gradients are nonzero (the test would pass trivially otherwise)
     assert float(jnp.max(jnp.abs(gc_r))) > 1e-3
+
+
+def test_validvector_remaining_dunders():
+    """Right-operand and unary dunders (reference overloads ~80 Base
+    operators; these are the Python-dunder subset)."""
+    a = ValidVector(jnp.asarray([1.0, 2.0]), jnp.bool_(True))
+    np.testing.assert_allclose(np.asarray((3.0 - a).x), [2.0, 1.0])
+    np.testing.assert_allclose(np.asarray((2.0 / a).x), [2.0, 1.0])
+    np.testing.assert_allclose(np.asarray((a ** 2).x), [1.0, 4.0])
+    np.testing.assert_allclose(np.asarray((2.0 ** a).x), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray((-a).x), [-1.0, -2.0])
+    np.testing.assert_allclose(np.asarray(abs(-a).x), [1.0, 2.0])
+    np.testing.assert_allclose(np.asarray((a % 2.0).x), [1.0, 0.0])
+    # safe_pow: negative base with fractional exponent invalidates
+    neg = ValidVector(jnp.asarray([-2.0, 1.0]), jnp.bool_(True))
+    assert not bool((neg ** 0.5).valid)
