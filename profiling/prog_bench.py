@@ -1,0 +1,102 @@
+"""A/B the program kernel: compile cost vs kernel cost vs old-style call.
+
+Usage: prog_bench.py [T] [TB] [avg_len]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    T = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    TB = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    from _common import make_bench_problem, timeit
+    from symbolicregression_jl_tpu.ops.fused_eval import (
+        fused_loss, fused_loss_program, fused_grad_program)
+    from symbolicregression_jl_tpu.ops.program import compile_program
+    from symbolicregression_jl_tpu.evolve.population import init_population
+
+    options, ds, engine = make_bench_problem()
+    cfg = engine.cfg
+    X, y = ds.data.Xt, ds.data.y
+    F = X.shape[0]
+    nB = len(cfg.operators.binary)
+
+    trees = init_population(jax.random.PRNGKey(0), T, cfg.mctx, jnp.float32)
+    lens = np.asarray(trees.length)
+    prog0 = jax.jit(lambda tr: compile_program(tr, F, nB),
+                    static_argnums=())(trees)
+    steps = np.asarray(prog0.nsteps)
+    print(f"tree len: mean {lens.mean():.1f} max {lens.max()}  "
+          f"steps: mean {steps.mean():.1f} max {steps.max()}")
+
+    compile_fn = jax.jit(lambda tr: compile_program(tr, F, nB))
+
+    def chain(fn, x0, n=30):
+        out = fn(x0)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(out) if isinstance(out, type(x0)) else fn(x0)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / n
+
+    # 1. compile alone (chained via const feedback)
+    @jax.jit
+    def compile_step(tr):
+        p = compile_program(tr, F, nB)
+        eps = jnp.sum(p.cvals) * 1e-30
+        return dataclasses.replace(tr, const=tr.const + eps)
+
+    dt = chain(compile_step, trees)
+    print(f"compile_program:      {dt*1e3:7.3f} ms/launch  {T/dt:>9.0f} tree/s")
+
+    # 2. kernel alone (program precompiled; chained via cvals feedback)
+    @jax.jit
+    def kernel_step(p):
+        loss, valid = fused_loss_program(
+            p, X, y, None, F, cfg.operators, options.elementwise_loss,
+            tree_block=TB)
+        eps = jnp.nanmin(jnp.where(jnp.isfinite(loss), loss, jnp.inf)) * 1e-30
+        return dataclasses.replace(p, cvals=p.cvals + eps)
+
+    dt = chain(kernel_step, prog0)
+    print(f"fused_loss_program:   {dt*1e3:7.3f} ms/launch  {T/dt:>9.0f} ev/s")
+
+    # 3. full fused_loss (compile + kernel)
+    @jax.jit
+    def full_step(tr):
+        loss, valid = fused_loss(
+            tr, X, y, None, cfg.operators, options.elementwise_loss,
+            tree_block=TB)
+        eps = jnp.nanmin(jnp.where(jnp.isfinite(loss), loss, jnp.inf)) * 1e-30
+        return dataclasses.replace(tr, const=tr.const + eps)
+
+    dt = chain(full_step, trees)
+    print(f"fused_loss (full):    {dt*1e3:7.3f} ms/launch  {T/dt:>9.0f} ev/s")
+
+    # 4. grad kernel (program precompiled)
+    @jax.jit
+    def grad_step(p):
+        loss, valid, g = fused_grad_program(
+            p, X, y, None, F, cfg.operators, options.elementwise_loss,
+            tree_block=TB)
+        eps = jnp.nanmin(jnp.where(jnp.isfinite(loss), loss, jnp.inf)) * 1e-30
+        return dataclasses.replace(p, cvals=p.cvals + eps)
+
+    dt = chain(grad_step, prog0)
+    print(f"fused_grad_program:   {dt*1e3:7.3f} ms/launch  {T/dt:>9.0f} ev/s")
+
+
+if __name__ == "__main__":
+    main()

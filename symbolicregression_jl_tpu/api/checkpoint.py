@@ -17,8 +17,10 @@ clear error before any state is touched.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import os
 import pickle
+import warnings
 from typing import TYPE_CHECKING, List
 
 import jax
@@ -49,6 +51,20 @@ def options_compat_header(options: "Options") -> dict:
             spec_desc, st.expr_keys, st.num_features, st.param_keys,
             st.num_params, st.n_variables,
         )
+    # Best-effort combiner fingerprint: a structurally identical template
+    # with a *different* combine function would otherwise pass the check
+    # and silently resume under a new objective. Bytecode isn't stable
+    # across Python versions, so mismatches warn rather than fail.
+    fp = None
+    if spec is not None and hasattr(spec, "structure"):
+        fn = spec.structure.combine
+        code = getattr(fn, "__code__", None)
+        digest = None
+        if code is not None:
+            h = hashlib.sha1(code.co_code)
+            h.update(repr(code.co_consts).encode())  # literals differ too
+            digest = h.hexdigest()[:16]
+        fp = (getattr(fn, "__qualname__", repr(fn)), digest)
     # Field list comes from the same source as the in-memory warm-start
     # check (Options._WARM_START_FIELDS) so the two can't drift — for
     # disk resumes this header IS the compatibility check (the loaded
@@ -63,7 +79,11 @@ def options_compat_header(options: "Options") -> dict:
         tuple(op.name for op in options.operators.binary),
     )
     header["expression_spec"] = spec_desc
+    header["template_combiner_fp"] = fp
     return header
+
+
+_KNOWN_KEY_IMPLS = ("threefry2x32", "rbg", "unsafe_rbg")
 
 
 def _to_numpy_state(ds):
@@ -73,6 +93,11 @@ def _to_numpy_state(ds):
 
 
 def _to_device_state(ds, key_impl: str = "threefry2x32"):
+    if key_impl not in _KNOWN_KEY_IMPLS:
+        raise ValueError(
+            f"Checkpoint uses unknown PRNG key impl {key_impl!r}; "
+            f"known: {_KNOWN_KEY_IMPLS}"
+        )
     return dataclasses.replace(
         ds, key=jax.random.wrap_key_data(
             jax.numpy.asarray(ds.key), impl=key_impl
@@ -80,17 +105,38 @@ def _to_device_state(ds, key_impl: str = "threefry2x32"):
     )
 
 
+def _key_impl_name(state: "SearchState") -> str:
+    """Record the *actual* key impl so a non-default key (e.g. rbg)
+    round-trips instead of being silently reinterpreted on resume."""
+    if not state.device_states:
+        return "threefry2x32"
+    return str(jax.random.key_impl(state.device_states[0].key))
+
+
 def save_search_state(path: str, state: "SearchState") -> None:
     """Serialize a SearchState (the ``return_state=True`` result) to disk.
 
     Double-write (tmp + atomic replace) matching the CSV checkpoint
     discipline (src/SearchUtils.jl:605-649).
+
+    Multi-process runs skip the pickle: the state is island-sharded
+    across all hosts' devices, this function runs on rank 0 only, and
+    any cross-host gather here would be a one-sided collective (deadlock).
+    The per-iteration hall-of-fame CSVs remain the multi-host artifact.
     """
+    if jax.process_count() > 1:
+        warnings.warn(
+            "save_search_state: skipping full-state pickle in a "
+            "multi-process run (island shards span non-addressable "
+            "devices); hall-of-fame CSVs are still written.",
+            stacklevel=2,
+        )
+        return
     payload = {
         "format_version": _FORMAT_VERSION,
         "compat": options_compat_header(state.options),
         "num_evals": float(state.num_evals),
-        "key_impl": "threefry2x32",
+        "key_impl": _key_impl_name(state),
         "nfeatures": state.nfeatures,
         "device_states": [_to_numpy_state(ds) for ds in state.device_states],
     }
@@ -118,10 +164,20 @@ def load_search_state(path: str, options: "Options") -> "SearchState":
         )
     saved = payload["compat"]
     now = options_compat_header(options)
-    issues = [k for k in now if saved.get(k) != now[k]]
+    issues = [k for k in now
+              if k != "template_combiner_fp" and saved.get(k) != now[k]]
     if issues:
         raise ValueError(
             f"Checkpoint incompatible with current options; changed: {issues}"
+        )
+    if ("template_combiner_fp" in saved
+            and saved["template_combiner_fp"] != now.get(
+                "template_combiner_fp")):
+        warnings.warn(
+            "Checkpoint was saved under a template combine function whose "
+            "fingerprint differs from the current one; resuming will score "
+            "carried-over losses under the new objective.",
+            stacklevel=2,
         )
     device_states = [
         _to_device_state(ds, payload.get("key_impl", "threefry2x32"))

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from ..core.losses import aggregate_loss, loss_to_cost
 from ..ops.encoding import LEAF_CONST, TreeBatch, tree_structure_arrays
 from ..ops.eval import eval_single_tree
-from ..ops.fused_eval import fused_loss, fused_loss_and_const_grad
+from ..ops.fused_eval import fused_grad_multi, fused_loss_multi
+from ..ops.program import compile_program
 
 __all__ = ["OptimizerConfig", "optimize_constants_batch",
            "optimize_constants_fused", "optimize_constants_template"]
@@ -113,10 +114,11 @@ def optimize_constants_fused(
 ):
     """TPU-shaped BFGS: the line search is batched *across* members and
     candidate step sizes into one fused-kernel launch per BFGS iteration
-    (candidates = trees with different constant vectors), and the
-    gradient comes from the fused forward+backward kernel
-    (`fused_loss_and_const_grad`) — no [T, L, n] interpreter buffers ever
-    touch HBM. Sequential depth per iteration is 2 kernel launches.
+    (candidates = constant-vector variants riding the multi-variant
+    kernels' variants axis — one instruction dispatch per unique tree),
+    and the gradient comes from the fused forward+backward kernel
+    (`fused_grad_multi`) — no [T, L, n] interpreter buffers ever touch
+    HBM. Sequential depth per iteration is 2 kernel launches.
 
     Semantics match `optimize_constants_batch` (same Armijo backtracking,
     restarts, accept-if-better rule); restarts ride the member axis.
@@ -130,57 +132,55 @@ def optimize_constants_fused(
         y = jnp.take(data.y, batch_idx)
         w = None if data.weights is None else jnp.take(data.weights, batch_idx)
 
-    child, _, _ = tree_structure_arrays(trees, need_depth=False)
-    slot = jnp.arange(L)
-    cmask = (
-        (slot[None, :] < trees.length[:, None])
-        & (trees.arity == 0)
-        & (trees.op == LEAF_CONST)
-    )  # [P, L]
+    F = X.shape[0]
+
+    # Compile the tree structures ONCE and optimize directly in the
+    # program's *compressed* constant space (ops/program.py): the
+    # optimization variables are cvals [*, CMAX], the fused gradient
+    # kernel already produces gradients in that space, and the L-BFGS
+    # state halves. The [P, L, L] span math and all slot scatters stay
+    # out of the BFGS loop; the winning constants scatter back into
+    # slot order once at the end.
+    prog = compile_program(trees, F, len(operators.binary))
+    CM = prog.cmax
+    used = (jnp.arange(CM, dtype=jnp.int32)[None, :]
+            < prog.nconst[:, None])  # [P, CM]
 
     # Expand members × restarts: x0 and perturbed starts x0*(1+0.5ε)
     # (src/ConstantOptimization.jl:90-100).
-    eps = jax.random.normal(key, (P, cfg.nrestarts, L), trees.const.dtype)
+    eps = jax.random.normal(key, (P, cfg.nrestarts, CM), trees.const.dtype)
+    base = prog.cvals
     starts = jnp.concatenate(
-        [trees.const[:, None], trees.const[:, None] * (1.0 + 0.5 * eps)],
-        axis=1,
-    )  # [P, R, L]
-    x = starts.reshape(P * R, L)
-    mask_r = jnp.repeat(cmask, R, axis=0)  # [P*R, L]
+        [base[:, None], base[:, None] * (1.0 + 0.5 * eps)], axis=1,
+    )  # [P, R, CM]
+    x = starts.reshape(P * R, CM)
+    mask_r = jnp.repeat(used, R, axis=0)  # [P*R, CM]
 
-    rep_r = lambda a: jnp.repeat(a, R, axis=0)
-    trees_r = TreeBatch(
-        arity=rep_r(trees.arity), op=rep_r(trees.op), feat=rep_r(trees.feat),
-        const=rep_r(trees.const), length=jnp.repeat(trees.length, R),
-    )
-    child_r = rep_r(child)
-
-    def vg(consts):  # [P*R, L] -> (loss [P*R], grad [P*R, L])
-        cand = dataclasses.replace(trees_r, const=consts)
-        loss, _, grad = fused_loss_and_const_grad(
-            cand, child_r, X, y, w, operators, elementwise_loss,
+    def vg(consts):  # [P*R, CM] -> (loss [P*R], grad [P*R, CM])
+        # R restart variants of one tree share the multi-variant grad
+        # kernel's variants axis (same dispatch-amortization as the line
+        # search below).
+        cv = consts.reshape(P, R, CM)
+        loss, _, gcomp = fused_grad_multi(
+            prog, cv, X, y, w, F, operators, elementwise_loss,
             interpret=interpret,
         )
-        return loss, jnp.where(mask_r, grad, 0.0)
+        grad = gcomp.reshape(P * R, CM)
+        return loss.reshape(P * R), jnp.where(mask_r, grad, 0.0)
 
     ts = cfg.shrink ** jnp.arange(cfg.max_linesearch, dtype=x.dtype)  # [C]
     C = cfg.max_linesearch
 
-    # Hoist the [P*R*C] tree-field replication out of the BFGS scan: only
-    # the constant vectors change between line-search launches, and these
-    # repeats were costing more than the eval kernel itself.
-    rep_rc = lambda a: jnp.repeat(a, R * C, axis=0)
-    trees_rc = TreeBatch(
-        arity=rep_rc(trees.arity), op=rep_rc(trees.op),
-        feat=rep_rc(trees.feat), const=rep_rc(trees.const),
-        length=jnp.repeat(trees.length, R * C),
-    )
-
-    def fused_many(consts):  # [P*R*C, L] -> loss [P*R*C]
-        cand = dataclasses.replace(trees_rc, const=consts)
-        loss, _ = fused_loss(cand, X, y, w, operators, elementwise_loss,
-                             interpret=interpret)
-        return loss
+    def fused_many(cand_x):  # [P*R, C, CM] -> loss [P*R, C]
+        # All R*C constant variants of one tree ride the multi-variant
+        # kernel's variants axis: ONE instruction-stream dispatch per
+        # tree instead of R*C replicated trees (the per-step scalar
+        # dispatch is the dominant kernel cost).
+        cv = cand_x.reshape(P, R * C, CM)
+        loss, _ = fused_loss_multi(
+            prog, cv, X, y, w, F, operators, elementwise_loss,
+            interpret=interpret)
+        return loss.reshape(P * R, C)
 
     fx0, g0 = vg(x)
     calls0 = jnp.ones((P * R,), jnp.float32)
@@ -193,8 +193,8 @@ def optimize_constants_fused(
     # arithmetic.
     M = P * R
     hlen = min(int(cfg.iterations), 8)
-    S0 = jnp.zeros((hlen, M, L), x.dtype)
-    Y0 = jnp.zeros((hlen, M, L), x.dtype)
+    S0 = jnp.zeros((hlen, M, CM), x.dtype)
+    Y0 = jnp.zeros((hlen, M, CM), x.dtype)
     rho0 = jnp.zeros((hlen, M), x.dtype)
 
     def lbfgs_direction(g, S, Y, rho):
@@ -224,9 +224,9 @@ def optimize_constants_fused(
         d = jnp.where(use_sd[:, None], -g, d)
         dg = jnp.where(use_sd, -jnp.sum(g * g, axis=1), dg)
 
-        # all candidate steps in ONE fused launch: [P*R, C, L]
+        # all candidate steps in ONE fused launch: [P*R, C, CM]
         cand_x = x[:, None, :] + ts[None, :, None] * d[:, None, :]
-        f_cand = fused_many(cand_x.reshape(P * R * C, L)).reshape(P * R, C)
+        f_cand = fused_many(cand_x)
         armijo = (
             f_cand <= fx[:, None] + cfg.c1 * ts[None, :] * dg[:, None]
         ) & jnp.isfinite(f_cand)
@@ -257,12 +257,15 @@ def optimize_constants_fused(
     # restart 0 starts at trees.const, so its initial value IS the baseline.
     baseline = fx0.reshape(P, R)[:, 0]
     fx = jnp.where(jnp.isnan(fx), jnp.inf, fx).reshape(P, R)
-    xs = x.reshape(P, R, L)
+    xs = x.reshape(P, R, CM)
     best_r = jnp.argmin(fx, axis=1)
     f_best = jnp.take_along_axis(fx, best_r[:, None], axis=1)[:, 0]
     x_best = jnp.take_along_axis(xs, best_r[:, None, None], axis=1)[:, 0]
     improved = do_opt & (f_best < baseline) & jnp.isfinite(f_best)
-    new_const = jnp.where(improved[:, None] & cmask, x_best, trees.const)
+    # one scatter back to slot order for the winners
+    scattered = trees.const.at[jnp.arange(P)[:, None], prog.cslot].set(
+        x_best, mode="drop")
+    new_const = jnp.where(improved[:, None], scattered, trees.const)
     f_calls = jnp.sum(calls.reshape(P, R), axis=1) * do_opt
     return new_const, improved, jnp.where(improved, f_best, baseline), f_calls
 

@@ -35,9 +35,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .encoding import LEAF_CONST, LEAF_VAR, TreeBatch, tree_structure_arrays
 from .operators import OperatorSet
+from .program import TreeProgram, compile_program
 
-__all__ = ["fused_loss", "fused_loss_and_const_grad", "fused_predict",
-           "fused_predict_ad", "stack_positions", "supports_fused_eval"]
+__all__ = ["fused_loss", "fused_loss_program", "fused_loss_and_const_grad",
+           "fused_predict", "fused_predict_ad", "stack_positions",
+           "supports_fused_eval"]
 
 
 def stack_positions(arity: jax.Array) -> jax.Array:
@@ -129,62 +131,130 @@ def _tree_kernel_body(
     return vmask * jnp.isfinite(val).astype(vmask.dtype)
 
 
-def _make_kernel(
+# ---------------------------------------------------------------------------
+# Program kernel: leaf-free interpreter over a unified VMEM value buffer
+# ---------------------------------------------------------------------------
+#
+# See ops/program.py for the lowering. The interpreter state is one
+# buffer of row vectors:
+#   buf[0:F]        X feature rows (copied once per grid step)
+#   buf[F:BASE]     this tree's constant leaves, broadcast across rows
+#   buf[BASE+k]     result of program step k
+# Steps dispatch ONE merged opcode (identity | unary ops | binary ops)
+# via lax.switch; operands are uniform dynamic reads buf[src], so leaf
+# handling, the arity switch, and the per-operand source selects all
+# disappear from the inner loop. Steps per tree = internal nodes only.
+
+
+def _merged_branches(operators: OperatorSet, read, i1, i2):
+    """Branch list for the merged opcode switch at one program step.
+
+    Order matches ops/program.py's code assignment: 0 = identity (for
+    leaf-only trees), then binary ops (the most frequent class — the
+    switch tests codes in order), then unary. Operand reads (``read`` is
+    the kernel's buffer accessor) live inside each branch so unary steps
+    never touch src2.
+    """
+    branches = [lambda: read(i1)]
+    for o in operators.binary:
+        branches.append(lambda f=o.fn: f(read(i1), read(i2)))
+    for o in operators.unary:
+        branches.append(lambda f=o.fn: f(read(i1)))
+    return branches
+
+
+def _unpack(w):
+    """Instruction word -> (opcode, src1, src2); see pack in the wrappers."""
+    return w >> 24, (w >> 12) & 0xFFF, w & 0xFFF
+
+
+def _pack_instr(prog: TreeProgram) -> jax.Array:
+    """[T, L] int32 instruction words (op << 24 | src1 << 12 | src2)."""
+    return (prog.code << 24) | (prog.src1 << 12) | prog.src2
+
+
+def _check_packable(operators: OperatorSet, base: int, max_steps: int) -> None:
+    """Fail loudly (at trace time) when a configuration overflows the
+    packed fields: 12-bit operand addresses, 7-bit opcodes (bit 31 must
+    stay clear — the unpack uses an arithmetic shift)."""
+    n_codes = 1 + len(operators.binary) + len(operators.unary)
+    if base + max_steps > 4096:
+        raise ValueError(
+            f"Buffer address space {base + max_steps} exceeds the packed "
+            f"12-bit operand field (nfeatures + cmax + max_nodes <= 4096)."
+        )
+    if n_codes > 127:
+        raise ValueError(
+            f"{n_codes} merged opcodes exceed the packed 7-bit field.")
+
+
+def _make_program_kernel(
     operators: OperatorSet,
     loss_fn: Callable,
-    max_nodes: int,
     tree_block: int,
-    weighted: bool,
+    nfeat: int,
+    cmax: int,
 ):
-    unary_fns = tuple(op.fn for op in operators.unary)
-    binary_fns = tuple(op.fn for op in operators.binary)
+    BASE = nfeat + cmax
 
     def kernel(
-        arity_ref,   # SMEM [TB, L]
-        op_ref,      # SMEM [TB, L]
-        feat_ref,    # SMEM [TB, L]
-        dst_ref,     # SMEM [TB, L] (clamped to stack size by the wrapper)
-        length_ref,  # SMEM [TB, 1] (used slot count per tree)
-        const_ref,   # SMEM [TB, L] f32
+        instr_ref,   # SMEM [TB, L] packed instruction words
+        nstep_ref,   # SMEM [TB, 1]
+        nconst_ref,  # SMEM [TB, 1]
+        cvals_ref,   # SMEM [TB, CMAX] f32
+        ok_ref,      # SMEM [TB, 1] int32 — const_ok from the program
         x_ref,       # VMEM [F, TILE]
         y_ref,       # VMEM [1, TILE]
-        w_ref,       # VMEM [1, TILE] (ones when unweighted)
-        mask_ref,    # VMEM [1, TILE] f32: 1.0 for real rows, 0.0 padding
+        w_ref,       # VMEM [1, TILE]
+        mask_ref,    # VMEM [1, TILE] f32: 1.0 real rows
         loss_ref,    # SMEM out [TB, 1] f32
         valid_ref,   # SMEM out [TB, 1] int32
-        stack_ref,   # VMEM scratch [TB, S, TILE]
+        buf_ref,     # VMEM scratch [BASE + L, TILE]
     ):
         j = pl.program_id(1)
         y_row = y_ref[0, :]
         mask_row = mask_ref[0, :] > 0
         w_row = w_ref[0, :] * mask_ref[0, :]
         tile = y_row.shape[0]
+        L = instr_ref.shape[-1]
+
+        buf_ref[0:nfeat, :] = x_ref[...]
 
         for t in range(tree_block):
-            def body(k, vmask):
-                return _tree_kernel_body(
-                    t, k, arity_ref, op_ref, feat_ref, dst_ref, const_ref,
-                    x_ref, stack_ref, vmask,
-                    unary_fns, binary_fns,
-                )
+            def cbody(c, _):
+                buf_ref[nfeat + c, :] = jnp.full(
+                    (tile,), cvals_ref[t, c], dtype=y_row.dtype)
+                return 0
 
-            # Dynamic trip count: padding slots past `length` are pure
-            # no-ops (leaf writes above the live stack region), so the
-            # loop stops at the tree's real size — evolved trees average
-            # well under the maxsize slot budget, which makes this the
-            # single biggest eval-throughput lever.
-            vmask = jax.lax.fori_loop(
-                0, length_ref[t, 0], body,
-                jnp.ones((tile,), y_row.dtype),
-            )
+            jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
+
+            def step(k, vmask):
+                o, i1, i2 = _unpack(instr_ref[t, k])
+                val = jax.lax.switch(
+                    o, _merged_branches(
+                        operators, lambda i: buf_ref[i, :], i1, i2))
+                buf_ref[BASE + k, :] = val
+                return vmask * jnp.isfinite(val).astype(vmask.dtype)
+
+            m = nstep_ref[t, 0]
+
+            # 2x-unrolled loop: the scalar-core loop overhead is a real
+            # fraction of the ~hundreds of cycles each step costs. Odd
+            # tails re-execute a clamped step idempotently (identity-coded
+            # padding rows read a real, finite address).
+            def pair(k2, vmask):
+                vmask = step(2 * k2, vmask)
+                vmask = step(jnp.minimum(2 * k2 + 1, L - 1), vmask)
+                return vmask
+
+            vmask0 = jnp.ones((tile,), y_row.dtype)
+            vmask = jax.lax.fori_loop(0, (m + 1) >> 1, pair, vmask0)
             valid = jnp.all((vmask > 0) | jnp.logical_not(mask_row))
-            pred = stack_ref[t, 0, :]
+            pred = buf_ref[BASE + m - 1, :]
             elt = loss_fn(pred, y_row)
-            # Zero padded/invalid rows *before* the sum so NaN padding
-            # can't poison the accumulator; validity is tracked separately.
             elt = jnp.where(w_row > 0, elt, 0.0)
             partial = jnp.sum(elt * w_row)
-            partial_ok = jnp.int32(valid & jnp.isfinite(partial))
+            partial_ok = jnp.int32(valid & jnp.isfinite(partial)) * ok_ref[t, 0]
 
             @pl.when(j == 0)
             def _():
@@ -197,6 +267,603 @@ def _make_kernel(
                 valid_ref[t, 0] = valid_ref[t, 0] & partial_ok
 
     return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "nfeatures", "operators", "loss_fn", "tree_block", "tile_rows",
+        "interpret",
+    ),
+)
+def fused_loss_program(
+    prog: TreeProgram,          # flat [T, L] program
+    X: jax.Array,               # [F, n]
+    y: jax.Array,               # [n]
+    weights: Optional[jax.Array],
+    nfeatures: int,
+    operators: OperatorSet,
+    loss_fn: Callable,
+    *,
+    tree_block: int = 8,
+    tile_rows: int = 16384,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Mean elementwise loss per compiled tree program (flat [T])."""
+    T, L = prog.code.shape
+    CMAX = prog.cmax
+    F, n = X.shape
+    dtype = X.dtype
+    BASE = nfeatures + CMAX
+    _check_packable(operators, BASE, L)
+
+    TB = tree_block
+    bytes_per = jnp.dtype(dtype).itemsize
+    TILE = _pick_tile(n, tile_rows, BASE + L, bytes_per)
+    T_pad = _round_up(T, TB)
+    n_pad = _round_up(n, TILE)
+
+    def pad_t(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
+                       constant_values=fill)
+
+    instr = pad_t(_pack_instr(prog))
+    nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
+    nconst = pad_t(prog.nconst.reshape(-1, 1))
+    cvals = pad_t(prog.cvals).astype(dtype)
+    ok = pad_t(prog.const_ok.astype(jnp.int32).reshape(-1, 1), fill=1)
+
+    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+    yp = jnp.pad(y.reshape(1, n), ((0, 0), (0, n_pad - n)))
+    w = (jnp.ones((1, n), dtype) if weights is None
+         else weights.reshape(1, n).astype(dtype))
+    wp = jnp.pad(w, ((0, 0), (0, n_pad - n)))
+    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
+
+    grid = (T_pad // TB, n_pad // TILE)
+    kernel = _make_program_kernel(operators, loss_fn, TB, nfeatures, CMAX)
+
+    smem_i32 = lambda shape: pl.BlockSpec(
+        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
+    )
+    row_spec = pl.BlockSpec((1, TILE), lambda i, j: (0, j))
+
+    loss_sum, valid = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            smem_i32((TB, L)),                       # instr
+            smem_i32((TB, 1)),                       # nsteps
+            smem_i32((TB, 1)),                       # nconst
+            pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),   # cvals
+            smem_i32((TB, 1)),                       # const_ok
+            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),  # X
+            row_spec,                                # y
+            row_spec,                                # w
+            row_spec,                                # mask
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_pad, 1), dtype),
+            jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((BASE + L, TILE), dtype)],
+        interpret=interpret,
+    )(instr, nsteps, nconst, cvals, ok, Xp, yp, wp, maskp)
+
+    loss_sum = loss_sum[:T, 0]
+    valid = valid[:T, 0].astype(jnp.bool_)
+    denom = jnp.sum(w) if weights is not None else jnp.asarray(n, dtype)
+    loss = loss_sum / denom
+    loss = jnp.where(valid & jnp.isfinite(loss), loss, jnp.inf)
+    return loss, valid
+
+
+# ---------------------------------------------------------------------------
+# Multi-variant program kernel: one dispatch, V constant vectors
+# ---------------------------------------------------------------------------
+#
+# The BFGS line search evaluates every selected tree with R*C different
+# constant vectors per iteration; replicating the tree per variant pays
+# the (dominant) per-step scalar dispatch cost V times for identical
+# instruction streams. Here the value buffer grows a variants axis —
+# buf[slot, v, rows] — so each step's single dispatch drives V row
+# vectors: dispatch cost per *eval* drops ~V-fold while the vector work
+# is the same total. X rows are replicated across v (variant-independent
+# but kept in the unified address space); only the const region differs.
+
+
+def _make_multi_kernel(
+    operators: OperatorSet,
+    loss_fn: Callable,
+    tree_block: int,
+    nfeat: int,
+    cmax: int,
+    nvar: int,
+):
+    BASE = nfeat + cmax
+    V = nvar
+
+    def kernel(
+        instr_ref,   # SMEM [TB, L]
+        nstep_ref,   # SMEM [TB, 1]
+        nconst_ref,  # SMEM [TB, 1]
+        cvals_ref,   # SMEM [TB, V * CMAX] f32 (variant-major)
+        x_ref,       # VMEM [F, TILE]
+        y_ref,       # VMEM [1, TILE]
+        w_ref,       # VMEM [1, TILE]
+        mask_ref,    # VMEM [1, TILE]
+        loss_ref,    # VMEM out [TB, V] f32
+        valid_ref,   # VMEM out [TB, V] int32
+        buf_ref,     # VMEM scratch [BASE + L, V, TILE]
+    ):
+        j = pl.program_id(1)
+        y_row = y_ref[0, :]
+        mask_row = mask_ref[0, :] > 0
+        w_row = w_ref[0, :] * mask_ref[0, :]
+        tile = y_row.shape[0]
+        L = instr_ref.shape[-1]
+
+        buf_ref[0:nfeat, :, :] = jnp.broadcast_to(
+            x_ref[...][:, None, :], (nfeat, V, tile))
+
+        for t in range(tree_block):
+            def cbody(c, _):
+                for v in range(V):
+                    buf_ref[nfeat + c, v, :] = jnp.full(
+                        (tile,), cvals_ref[t, v * cmax + c],
+                        dtype=y_row.dtype)
+                return 0
+
+            jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
+
+            def step(k, vmask):
+                o, i1, i2 = _unpack(instr_ref[t, k])
+                val = jax.lax.switch(
+                    o, _merged_branches(
+                        operators, lambda i: buf_ref[i, :, :], i1, i2))
+                buf_ref[BASE + k, :, :] = val
+                return vmask * jnp.isfinite(val).astype(vmask.dtype)
+
+            m = nstep_ref[t, 0]
+
+            def pair(k2, vmask):
+                vmask = step(2 * k2, vmask)
+                return step(jnp.minimum(2 * k2 + 1, L - 1), vmask)
+
+            vmask0 = jnp.ones((V, tile), y_row.dtype)
+            vmask = jax.lax.fori_loop(0, (m + 1) >> 1, pair, vmask0)
+            validv = jnp.all(
+                (vmask > 0) | jnp.logical_not(mask_row)[None, :], axis=1)
+            pred = buf_ref[BASE + m - 1, :, :]            # [V, TILE]
+            elt = loss_fn(pred, y_row[None, :])
+            elt = jnp.where(w_row[None, :] > 0, elt, 0.0)
+            partial = jnp.sum(elt * w_row[None, :], axis=1)  # [V]
+            partial_ok = (validv & jnp.isfinite(partial)).astype(jnp.int32)
+
+            @pl.when(j == 0)
+            def _():
+                loss_ref[t, :] = partial
+                valid_ref[t, :] = partial_ok
+
+            @pl.when(j != 0)
+            def _():
+                loss_ref[t, :] = loss_ref[t, :] + partial
+                valid_ref[t, :] = valid_ref[t, :] & partial_ok
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "nfeatures", "operators", "loss_fn", "tree_block", "interpret",
+    ),
+)
+def fused_loss_multi(
+    prog: TreeProgram,          # flat [T, L] program
+    cvals_v: jax.Array,         # [T, V, CMAX] constant vectors per variant
+    X: jax.Array,               # [F, n]
+    y: jax.Array,               # [n]
+    weights: Optional[jax.Array],
+    nfeatures: int,
+    operators: OperatorSet,
+    loss_fn: Callable,
+    *,
+    tree_block: int = 8,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Mean loss for every (tree, constant-variant) pair: [T, V] each.
+
+    One instruction-stream dispatch per tree serves all V variants;
+    invalid pairs (non-finite eval or non-finite constants) get inf.
+    """
+    T, L = prog.code.shape
+    CMAX = prog.cmax
+    V = cvals_v.shape[1]
+    F, n = X.shape
+    dtype = X.dtype
+    BASE = nfeatures + CMAX
+    _check_packable(operators, BASE, L)
+
+    TB = tree_block
+    bytes_per = jnp.dtype(dtype).itemsize
+    TILE = _pick_tile(n, n, (BASE + L) * V, bytes_per, budget=8 * 2**20)
+    T_pad = _round_up(T, TB)
+    n_pad = _round_up(n, TILE)
+
+    def pad_t(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
+                       constant_values=fill)
+
+    instr = pad_t(_pack_instr(prog))
+    nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
+    nconst = pad_t(prog.nconst.reshape(-1, 1))
+    cflat = pad_t(cvals_v.reshape(T, V * CMAX)).astype(dtype)
+
+    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+    yp = jnp.pad(y.reshape(1, n), ((0, 0), (0, n_pad - n)))
+    w = (jnp.ones((1, n), dtype) if weights is None
+         else weights.reshape(1, n).astype(dtype))
+    wp = jnp.pad(w, ((0, 0), (0, n_pad - n)))
+    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
+
+    grid = (T_pad // TB, n_pad // TILE)
+    kernel = _make_multi_kernel(operators, loss_fn, TB, nfeatures, CMAX, V)
+
+    smem_i32 = lambda shape: pl.BlockSpec(
+        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
+    )
+    row_spec = pl.BlockSpec((1, TILE), lambda i, j: (0, j))
+
+    loss_sum, valid = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            smem_i32((TB, L)),                       # instr
+            smem_i32((TB, 1)),                       # nsteps
+            smem_i32((TB, 1)),                       # nconst
+            pl.BlockSpec((TB, V * CMAX), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),   # cvals
+            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),  # X
+            row_spec,                                # y
+            row_spec,                                # w
+            row_spec,                                # mask
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, V), lambda i, j: (i, 0)),
+            pl.BlockSpec((TB, V), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_pad, V), dtype),
+            jax.ShapeDtypeStruct((T_pad, V), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((BASE + L, V, TILE), dtype)],
+        interpret=interpret,
+    )(instr, nsteps, nconst, cflat, Xp, yp, wp, maskp)
+
+    loss_sum = loss_sum[:T]
+    valid = valid[:T].astype(jnp.bool_)
+    # const_ok per variant, applied outside the kernel
+    used = (jnp.arange(CMAX, dtype=jnp.int32)[None, None, :]
+            < prog.nconst[:, None, None])
+    ok_v = jnp.all(jnp.isfinite(cvals_v) | ~used, axis=-1)
+    valid = valid & ok_v
+    denom = jnp.sum(w) if weights is not None else jnp.asarray(n, dtype)
+    loss = loss_sum / denom
+    loss = jnp.where(valid & jnp.isfinite(loss), loss, jnp.inf)
+    return loss, valid
+
+
+# ---------------------------------------------------------------------------
+# Program kernel, forward + backward: loss and d(loss)/d(const) fused
+# ---------------------------------------------------------------------------
+#
+# The adjoint sweep mirrors the forward program in reverse over the same
+# unified buffer addressing: step k's cotangent lives at adj[BASE+k],
+# operand contributions accumulate at adj[src] — which for constant-leaf
+# operands IS the const region, so per-constant gradients fall out as
+# row sums of adj[F : F+CMAX] with no slot bookkeeping in the kernel.
+# (X-region adjoint rows accumulate too and are simply never read.)
+
+
+def _make_multi_grad_kernel(
+    operators: OperatorSet,
+    loss_fn: Callable,
+    tree_block: int,
+    nfeat: int,
+    cmax: int,
+    nvar: int,
+):
+    unary_fns = tuple(op.fn for op in operators.unary)
+    binary_fns = tuple(op.fn for op in operators.binary)
+    BASE = nfeat + cmax
+    V = nvar
+
+    def kernel(
+        instr_ref,   # SMEM [TB, L] packed instruction words
+        nstep_ref,   # SMEM [TB, 1]
+        nconst_ref,  # SMEM [TB, 1]
+        cvals_ref,   # SMEM [TB, V * CMAX] f32 (variant-major)
+        x_ref,       # VMEM [F, TILE]
+        y_ref,       # VMEM [1, TILE]
+        w_ref,       # VMEM [1, TILE]
+        mask_ref,    # VMEM [1, TILE]
+        loss_ref,    # VMEM out [TB, V] f32
+        valid_ref,   # VMEM out [TB, V] int32
+        gcomp_ref,   # VMEM out [TB, CMAX, V] — d loss_sum / d cvals
+        buf_ref,     # VMEM scratch [BASE + L, V, TILE]
+        adj_ref,     # VMEM scratch [BASE + L, V, TILE]
+    ):
+        j = pl.program_id(1)
+        y_row = y_ref[0, :]
+        mask_row = mask_ref[0, :] > 0
+        w_row = w_ref[0, :] * mask_ref[0, :]
+        tile = y_row.shape[0]
+        B = len(binary_fns)
+        L = instr_ref.shape[-1]
+        read = lambda i: buf_ref[i, :, :]
+
+        buf_ref[0:nfeat, :, :] = jnp.broadcast_to(
+            x_ref[...][:, None, :], (nfeat, V, tile))
+
+        for t in range(tree_block):
+            def cbody(c, _):
+                for v in range(V):
+                    buf_ref[nfeat + c, v, :] = jnp.full(
+                        (tile,), cvals_ref[t, v * cmax + c],
+                        dtype=y_row.dtype)
+                return 0
+
+            jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
+
+            def fwd(k, vmask):
+                o, i1, i2 = _unpack(instr_ref[t, k])
+                val = jax.lax.switch(
+                    o, _merged_branches(operators, read, i1, i2))
+                buf_ref[BASE + k, :, :] = val
+                return vmask * jnp.isfinite(val).astype(vmask.dtype)
+
+            m = nstep_ref[t, 0]
+
+            def fwd_pair(k2, vmask):
+                vmask = fwd(2 * k2, vmask)
+                return fwd(jnp.minimum(2 * k2 + 1, L - 1), vmask)
+
+            vmask = jax.lax.fori_loop(
+                0, (m + 1) >> 1, fwd_pair, jnp.ones((V, tile), y_row.dtype))
+            validv = jnp.all(
+                (vmask > 0) | jnp.logical_not(mask_row)[None, :], axis=1)
+
+            pred = buf_ref[BASE + m - 1, :, :]             # [V, TILE]
+            elt, loss_vjp = jax.vjp(
+                lambda p: loss_fn(p, y_row[None, :]), pred)
+            elt = jnp.where(w_row[None, :] > 0, elt, 0.0)
+            partial = jnp.sum(elt * w_row[None, :], axis=1)  # [V]
+            partial_ok = (validv & jnp.isfinite(partial)).astype(jnp.int32)
+            (dpred,) = loss_vjp(jnp.broadcast_to(w_row[None, :], (V, tile)))
+            dpred = jnp.where(w_row[None, :] > 0, dpred, 0.0)
+
+            # Every node of a tree has exactly ONE parent, so each adjoint
+            # slot is written exactly once during the sweep — plain stores,
+            # no zero-init of the adjoint buffer, no read-modify-write.
+            # (Two operands of one step can only collide in the X region,
+            # whose adjoint rows are never read.) Unused const rows hold
+            # stale data from earlier trees; the final reduction loops
+            # only over the nconst used rows.
+            adj_ref[BASE + m - 1, :, :] = dpred
+
+            def bwd(k):
+                o, i1, i2 = _unpack(instr_ref[t, k])
+                ct = adj_ref[BASE + k, :, :]
+
+                # Padded rows carry zero cotangents but arbitrary operand
+                # values, so vjps can produce 0/0 = NaN there; mask before
+                # storing or one NaN poisons the gradient sums.
+                @pl.when(o == 0)
+                def _():
+                    adj_ref[i1, :, :] = ct
+
+                if binary_fns:
+                    @pl.when((o >= 1) & (o <= B))
+                    def _():
+                        x1 = read(i1)
+                        x2 = read(i2)
+                        if len(binary_fns) == 1:
+                            db1, db2 = _vjp_binary(binary_fns[0], x1, x2, ct)
+                        else:
+                            db1, db2 = jax.lax.switch(
+                                o - 1,
+                                [lambda xx, yy, cc, f=f:
+                                 _vjp_binary(f, xx, yy, cc)
+                                 for f in binary_fns], x1, x2, ct)
+                        adj_ref[i1, :, :] = jnp.where(
+                            mask_row[None, :], db1, 0.0)
+                        adj_ref[i2, :, :] = jnp.where(
+                            mask_row[None, :], db2, 0.0)
+
+                if unary_fns:
+                    @pl.when(o > B)
+                    def _():
+                        x1 = read(i1)
+                        if len(unary_fns) == 1:
+                            du = _vjp_unary(unary_fns[0], x1, ct)
+                        else:
+                            du = jax.lax.switch(
+                                o - 1 - B,
+                                [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
+                                 for f in unary_fns], x1, ct)
+                        adj_ref[i1, :, :] = jnp.where(
+                            mask_row[None, :], du, 0.0)
+
+            def bwd_pair(i2x, _):
+                # descending, 2x-unrolled; the odd tail re-executes step 0
+                # idempotently (pure assignments make that safe).
+                bwd(m - 1 - 2 * i2x)
+                bwd(jnp.maximum(m - 2 - 2 * i2x, 0))
+                return 0
+
+            jax.lax.fori_loop(0, (m + 1) >> 1, bwd_pair, 0)
+
+            @pl.when(j == 0)
+            def _():
+                gcomp_ref[t, :, :] = jnp.zeros(
+                    (cmax, V), dtype=y_row.dtype)
+                loss_ref[t, :] = partial
+                valid_ref[t, :] = partial_ok
+
+            @pl.when(j != 0)
+            def _():
+                loss_ref[t, :] = loss_ref[t, :] + partial
+                valid_ref[t, :] = valid_ref[t, :] & partial_ok
+
+            # Reduce only the USED const rows (dynamic loop over nconst):
+            # a full-CMAX masked reduce costs ~CMAX * TILE/1024 vector
+            # registers per tree, dominating short trees.
+            def gbody(c, _):
+                grow = jnp.sum(adj_ref[nfeat + c, :, :], axis=1)  # [V]
+                gcomp_ref[t, c, :] = gcomp_ref[t, c, :] + grow
+                return 0
+
+            jax.lax.fori_loop(0, nconst_ref[t, 0], gbody, 0)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "nfeatures", "operators", "loss_fn", "tree_block", "interpret",
+    ),
+)
+def fused_grad_multi(
+    prog: TreeProgram,          # flat [T, L] program
+    cvals_v: jax.Array,         # [T, V, CMAX]
+    X: jax.Array,               # [F, n]
+    y: jax.Array,               # [n]
+    weights: Optional[jax.Array],
+    nfeatures: int,
+    operators: OperatorSet,
+    loss_fn: Callable,
+    *,
+    tree_block: int = 8,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(loss [T, V], valid [T, V], dloss/dcvals [T, V, CMAX]) per
+    (tree, constant-variant) pair — one instruction dispatch per tree."""
+    T, L = prog.code.shape
+    CMAX = prog.cmax
+    V = cvals_v.shape[1]
+    F, n = X.shape
+    dtype = X.dtype
+    BASE = nfeatures + CMAX
+    _check_packable(operators, BASE, L)
+
+    TB = tree_block
+    bytes_per = jnp.dtype(dtype).itemsize
+    TILE = _pick_tile(n, n, 2 * (BASE + L) * V, bytes_per, budget=8 * 2**20)
+    T_pad = _round_up(T, TB)
+    n_pad = _round_up(n, TILE)
+
+    def pad_t(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
+                       constant_values=fill)
+
+    instr = pad_t(_pack_instr(prog))
+    nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
+    nconst = pad_t(prog.nconst.reshape(-1, 1))
+    cflat = pad_t(cvals_v.reshape(T, V * CMAX)).astype(dtype)
+
+    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+    yp = jnp.pad(y.reshape(1, n), ((0, 0), (0, n_pad - n)))
+    w = (jnp.ones((1, n), dtype) if weights is None
+         else weights.reshape(1, n).astype(dtype))
+    wp = jnp.pad(w, ((0, 0), (0, n_pad - n)))
+    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
+
+    grid = (T_pad // TB, n_pad // TILE)
+    kernel = _make_multi_grad_kernel(operators, loss_fn, TB, nfeatures,
+                                     CMAX, V)
+
+    smem_i32 = lambda shape: pl.BlockSpec(
+        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
+    )
+    row_spec = pl.BlockSpec((1, TILE), lambda i, j: (0, j))
+
+    loss_sum, valid, gcomp = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            smem_i32((TB, L)),                       # instr
+            smem_i32((TB, 1)),                       # nsteps
+            smem_i32((TB, 1)),                       # nconst
+            pl.BlockSpec((TB, V * CMAX), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),   # cvals
+            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),  # X
+            row_spec,                                # y
+            row_spec,                                # w
+            row_spec,                                # mask
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, V), lambda i, j: (i, 0)),
+            pl.BlockSpec((TB, V), lambda i, j: (i, 0)),
+            pl.BlockSpec((TB, CMAX, V), lambda i, j: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_pad, V), dtype),
+            jax.ShapeDtypeStruct((T_pad, V), jnp.int32),
+            jax.ShapeDtypeStruct((T_pad, CMAX, V), dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BASE + L, V, TILE), dtype),
+            pltpu.VMEM((BASE + L, V, TILE), dtype),
+        ],
+        interpret=interpret,
+    )(instr, nsteps, nconst, cflat, Xp, yp, wp, maskp)
+
+    loss_sum = loss_sum[:T]
+    valid = valid[:T].astype(jnp.bool_)
+    gcomp = jnp.swapaxes(gcomp[:T], 1, 2)              # [T, V, CMAX]
+    used = (jnp.arange(CMAX, dtype=jnp.int32)[None, None, :]
+            < prog.nconst[:, None, None])
+    ok_v = jnp.all(jnp.isfinite(cvals_v) | ~used, axis=-1)
+    valid = valid & ok_v
+    denom = jnp.sum(w) if weights is not None else jnp.asarray(n, dtype)
+    loss = loss_sum / denom
+    grad = gcomp / denom
+    bad = ~(valid & jnp.isfinite(loss))
+    loss = jnp.where(bad, jnp.inf, loss)
+    grad = jnp.where(bad[..., None] | ~jnp.isfinite(grad), 0.0, grad)
+    return loss, valid, grad
+
+
+def fused_grad_program(
+    prog: TreeProgram,          # flat [T, L] program
+    X: jax.Array,               # [F, n]
+    y: jax.Array,               # [n]
+    weights: Optional[jax.Array],
+    nfeatures: int,
+    operators: OperatorSet,
+    loss_fn: Callable,
+    *,
+    tree_block: int = 8,
+    tile_rows: int = 16384,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(loss [T], valid [T], dloss/dcvals [T, CMAX]) — the single-variant
+    view of `fused_grad_multi` (V = 1, constants from ``prog.cvals``)."""
+    del tile_rows
+    loss, valid, grad = fused_grad_multi(
+        prog, prog.cvals[:, None, :], X, y, weights, nfeatures, operators,
+        loss_fn, tree_block=tree_block, interpret=interpret,
+    )
+    return loss[:, 0], valid[:, 0], grad[:, 0]
 
 
 @functools.partial(
@@ -221,87 +888,19 @@ def fused_loss(
 
     Returns ``(loss[...], valid[...])`` with the TreeBatch's batch dims;
     invalid trees get loss=inf (matching aggregate_loss semantics).
+    Compiles the batch to a leaf-free TreeProgram (ops/program.py) and
+    runs the unified-buffer kernel; callers that re-evaluate the same
+    structures with different constants (line searches) should compile
+    once and use `fused_loss_program` + `update_consts` directly.
     """
     batch_shape = trees.batch_shape
     flat = trees.reshape(-1) if batch_shape else trees.reshape(1)
-    T = flat.length.shape[0]
-    L = flat.arity.shape[-1]
-    F, n = X.shape
-    dtype = X.dtype
-
-    TB = tree_block
-    S_est = L // 2 + 2
-    bytes_per = jnp.dtype(dtype).itemsize
-    TILE = _pick_tile(n, tile_rows, TB * S_est, bytes_per)
-    T_pad = _round_up(T, TB)
-    n_pad = _round_up(n, TILE)
-
-    def pad_trees(x, fill=0):
-        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
-                       constant_values=fill)
-
-    S = L // 2 + 2  # max postfix stack depth for L slots
-    arity = pad_trees(flat.arity)
-    op = pad_trees(flat.op)
-    feat = jnp.clip(pad_trees(flat.feat), 0, F - 1)
-    const = pad_trees(flat.const).astype(dtype)
-    length = jnp.clip(
-        pad_trees(flat.length.reshape(-1, 1), fill=1), 1, L
+    F = X.shape[0]
+    prog = compile_program(flat, F, len(operators.binary))
+    loss, valid = fused_loss_program(
+        prog, X, y, weights, F, operators, loss_fn,
+        tree_block=tree_block, tile_rows=tile_rows, interpret=interpret,
     )
-    # Padding slots' running stack positions keep growing past the live
-    # region; clamp into the scratch slot so their writes are in-bounds
-    # (they never touch slot 0 — see kernel docstring).
-    dst = jnp.clip(stack_positions(arity), 0, S - 1)
-
-    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
-    yp = jnp.pad(y.reshape(1, n), ((0, 0), (0, n_pad - n)))
-    w = jnp.ones((1, n), dtype) if weights is None else weights.reshape(1, n).astype(dtype)
-    wp = jnp.pad(w, ((0, 0), (0, n_pad - n)))
-    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
-
-    grid = (T_pad // TB, n_pad // TILE)
-    kernel = _make_kernel(operators, loss_fn, L, TB, weights is not None)
-
-    smem_i32 = lambda shape: pl.BlockSpec(
-        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
-    )
-    row_spec = pl.BlockSpec((1, TILE), lambda i, j: (0, j))
-
-    loss_sum, valid = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            smem_i32((TB, L)),                       # arity
-            smem_i32((TB, L)),                       # op
-            smem_i32((TB, L)),                       # feat
-            smem_i32((TB, L)),                       # dst
-            smem_i32((TB, 1)),                       # length
-            pl.BlockSpec((TB, L), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),   # const
-            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),  # X
-            row_spec,                                # y
-            row_spec,                                # w
-            row_spec,                                # mask
-        ],
-        out_specs=[
-            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T_pad, 1), dtype),
-            jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
-        ],
-        scratch_shapes=[pltpu.VMEM((TB, S, TILE), dtype)],
-        interpret=interpret,
-    )(arity, op, feat, dst, length, const, Xp, yp, wp, maskp)
-
-    loss_sum = loss_sum[:T, 0]
-    valid = valid[:T, 0].astype(jnp.bool_)
-    denom = jnp.sum(w) if weights is not None else jnp.asarray(n, dtype)
-    loss = loss_sum / denom
-    loss = jnp.where(valid & jnp.isfinite(loss), loss, jnp.inf)
     if batch_shape:
         return loss.reshape(batch_shape), valid.reshape(batch_shape)
     return loss[0], valid[0]
@@ -927,105 +1526,26 @@ def fused_loss_and_const_grad(
     ``loss`` is the mean elementwise loss (invalid => inf, matching
     `fused_loss`); the gradient is w.r.t. every constant-leaf slot of
     ``trees.const`` (zero elsewhere, zero for invalid trees).
+
+    Compatibility wrapper over the program path: compiles the batch and
+    scatters the compressed gradient back to slot order. ``child`` is
+    accepted for signature stability but unused (the program lowering
+    derives structure itself); optimizer loops should hoist the compile
+    and call `fused_grad_program` + `update_consts` directly.
     """
+    from .program import scatter_const_grads
+
+    del child
     batch_shape = trees.batch_shape
     flat = trees.reshape(-1) if batch_shape else trees.reshape(1)
-    ch_flat = child.reshape(-1, child.shape[-2], child.shape[-1])
-    T = flat.length.shape[0]
     L = flat.arity.shape[-1]
-    F, n = X.shape
-    dtype = X.dtype
-
-    TB = tree_block
-    bytes_per = jnp.dtype(dtype).itemsize
-    # scratch: buf + adj, both [L, TILE]
-    TILE = _pick_tile(n, tile_rows, 2 * L, bytes_per)
-    T_pad = _round_up(T, TB)
-    n_pad = _round_up(n, TILE)
-
-    def pad_trees(x, fill=0):
-        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
-                       constant_values=fill)
-
-    arity = pad_trees(flat.arity)
-    op = pad_trees(flat.op)
-    feat = jnp.clip(pad_trees(flat.feat), 0, F - 1)
-    const = pad_trees(flat.const).astype(dtype)
-    child1 = jnp.clip(pad_trees(ch_flat[..., 0]), 0, L - 1)
-    child2 = jnp.clip(pad_trees(ch_flat[..., 1]), 0, L - 1)
-    root = jnp.clip(
-        pad_trees(flat.length.reshape(-1, 1), fill=1) - 1, 0, L - 1
+    F = X.shape[0]
+    prog = compile_program(flat, F, len(operators.binary))
+    loss, valid, gcomp = fused_grad_program(
+        prog, X, y, weights, F, operators, loss_fn,
+        tree_block=tree_block, tile_rows=tile_rows, interpret=interpret,
     )
-    slot = jnp.arange(L)
-    cmask = (
-        (slot[None, :] < flat.length[:, None])
-        & (flat.arity == 0)
-        & (flat.op == LEAF_CONST)
-    ).astype(dtype)
-    cmask = pad_trees(cmask)
-
-    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
-    yp = jnp.pad(y.reshape(1, n), ((0, 0), (0, n_pad - n)))
-    w = jnp.ones((1, n), dtype) if weights is None else weights.reshape(1, n).astype(dtype)
-    wp = jnp.pad(w, ((0, 0), (0, n_pad - n)))
-    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
-
-    grid = (T_pad // TB, n_pad // TILE)
-    kernel = _make_grad_kernel(operators, loss_fn, L, TB)
-
-    smem_i32 = lambda shape: pl.BlockSpec(
-        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
-    )
-    row_spec = pl.BlockSpec((1, TILE), lambda i, j: (0, j))
-
-    loss_sum, valid, gconst = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            smem_i32((TB, L)),                       # arity
-            smem_i32((TB, L)),                       # op
-            smem_i32((TB, L)),                       # feat
-            smem_i32((TB, L)),                       # child1
-            smem_i32((TB, L)),                       # child2
-            smem_i32((TB, 1)),                       # root
-            pl.BlockSpec((TB, L), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),   # const
-            pl.BlockSpec((TB, L), lambda i, j: (i, 0)),    # cmask
-            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),  # X
-            row_spec,                                # y
-            row_spec,                                # w
-            row_spec,                                # mask
-        ],
-        out_specs=[
-            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((TB, L), lambda i, j: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T_pad, 1), dtype),
-            jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
-            jax.ShapeDtypeStruct((T_pad, L), dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((L, TILE), dtype),
-            pltpu.VMEM((L, TILE), dtype),
-        ],
-        interpret=interpret,
-    )(arity, op, feat, child1, child2, root, const, cmask, Xp, yp, wp, maskp)
-
-    loss_sum = loss_sum[:T, 0]
-    valid = valid[:T, 0].astype(jnp.bool_)
-    gconst = gconst[:T]
-    denom = jnp.sum(w) if weights is not None else jnp.asarray(n, dtype)
-    loss = loss_sum / denom
-    grad = gconst / denom
-    bad = ~(valid & jnp.isfinite(loss))
-    loss = jnp.where(bad, jnp.inf, loss)
-    grad = jnp.where(
-        bad[:, None] | ~jnp.isfinite(grad), 0.0, grad
-    )
+    grad = scatter_const_grads(prog, gcomp, L)
     if batch_shape:
         return (loss.reshape(batch_shape), valid.reshape(batch_shape),
                 grad.reshape(*batch_shape, L))

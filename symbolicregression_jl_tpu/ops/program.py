@@ -1,0 +1,198 @@
+"""Compressed internal-node programs for the fused TPU interpreters.
+
+The round-2 kernels interpreted every postfix slot — including leaves —
+with one `lax.switch` dispatch per slot. Leaves are ~half the slots of a
+binary-heavy tree, and each dispatch costs far more scalar-core time
+than the ~10 vector registers of row work it controls, so the kernels
+ran at a few percent of VPU throughput.
+
+This module "compiles" a TreeBatch into a leaf-free program over a
+single unified VMEM value buffer:
+
+    buf[0 : F]               — the X feature rows (written once per block)
+    buf[F : F+CMAX]          — the tree's constant-leaf values, broadcast
+                               across the row tile (one vector store)
+    buf[BASE : BASE+L]       — internal-node results, one slot per step
+                               (BASE = F + CMAX)
+
+Each program step k is an internal node in postfix order: a merged
+opcode (0 = identity/copy, 1..B = binary, B+1..B+U = unary — binary
+first because it's the most frequent class and the dispatch switch
+tests codes in order) plus one or two *unified buffer addresses* for
+its operands, packed into one int32 instruction word
+(op << 24 | src1 << 12 | src2) so the kernel issues a single SMEM read
+per step. Leaves vanish from the
+instruction stream — a VAR child is just an address < F, a CONST child
+an address in [F, BASE). The kernel's inner loop becomes: one switch,
+one or two uniform dynamic VMEM reads, one store. Steps per tree drop
+from `length` to the internal-node count (≈ length/2 for binary-heavy
+trees), and the arity switch disappears entirely.
+
+Validity semantics: the kernel checks finiteness of every *internal*
+node's output per row (matching the reference's per-node buffer check,
+/root/reference/src/LossFunctions.jl:96-99, for those nodes). Leaf
+outputs are X columns (finite datasets) and constants; non-finite
+constants are caught by `const_ok` computed here and ANDed into the
+kernel's verdict, so e.g. `exp(c)` with c = -inf (output 0.0, finite)
+is still invalid — same verdict as the reference, which flags the
+constant node itself. (A dataset containing non-finite rows is the one
+case that can diverge for pathological trees; `Dataset` inputs are
+expected finite.)
+
+The program is **constant-independent** except for `cvals`/`const_ok`:
+line searches and optimizer loops compile once per structure and call
+`update_consts` per candidate constant vector (a [T, CMAX] gather).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .encoding import LEAF_VAR, TreeBatch, _structure_from_arity
+
+__all__ = ["TreeProgram", "compile_program", "update_consts",
+           "const_mask_compressed", "scatter_const_grads", "program_cmax"]
+
+
+def program_cmax(max_nodes: int) -> int:
+    """Max constant leaves a tree of `max_nodes` slots can hold."""
+    return (max_nodes + 1) // 2
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TreeProgram:
+    """Leaf-free postfix program for a flat [T] batch of trees (pytree).
+
+    ``code``/``src1``/``src2`` are [T, L] (step axis padded with identity
+    steps past ``nsteps``); ``cvals``/``cslot`` are [T, CMAX] with
+    ``cslot == L`` marking unused constant slots; ``nsteps >= 1``.
+    """
+
+    code: jax.Array      # int32 [T, L] merged opcode per step
+    src1: jax.Array      # int32 [T, L] unified buffer address, operand 1
+    src2: jax.Array      # int32 [T, L] unified buffer address, operand 2
+    nsteps: jax.Array    # int32 [T]    executed steps (>= 1)
+    cvals: jax.Array     # float [T, CMAX] constant-leaf values
+    cslot: jax.Array     # int32 [T, CMAX] original slot of each const (L = unused)
+    nconst: jax.Array    # int32 [T]    used constant slots
+    const_ok: jax.Array  # bool  [T]    all live constant leaves finite
+
+    @property
+    def max_steps(self) -> int:
+        return self.code.shape[-1]
+
+    @property
+    def cmax(self) -> int:
+        return self.cvals.shape[-1]
+
+
+def compile_program(trees: TreeBatch, nfeatures: int, n_binary: int,
+                    ) -> TreeProgram:
+    """Lower a flat [T, L] TreeBatch to a TreeProgram (all jnp, jittable).
+
+    Single-leaf trees compile to one identity step copying the leaf's
+    address; `nsteps` is therefore always >= 1 and the root value lives
+    at buffer slot ``BASE + nsteps - 1``.
+
+    LEAF_PARAM leaves are treated as constant leaves (their `const`
+    field); callers on the parametric path must materialize parameter
+    values into `const` first (the turbo gate in evolve/step.py keeps
+    un-materialized parametric trees off this path).
+    """
+    arity, op, feat, const, length = (
+        trees.arity, trees.op, trees.feat, trees.const, trees.length)
+    T, L = arity.shape
+    cmax = program_cmax(L)
+    BASE = nfeatures + cmax
+    slot = jnp.arange(L, dtype=jnp.int32)
+
+    live = slot[None, :] < length[:, None]
+    internal = live & (arity > 0)
+    ci = jnp.cumsum(internal, axis=-1) - internal          # compressed idx
+    is_cleaf = live & (arity == 0) & (op != LEAF_VAR)
+    cj = jnp.cumsum(is_cleaf, axis=-1) - is_cleaf          # const idx
+
+    # Unified buffer address of every slot's value.
+    addr = jnp.where(
+        internal, BASE + ci,
+        jnp.where(op == LEAF_VAR, jnp.clip(feat, 0, nfeatures - 1),
+                  nfeatures + jnp.clip(cj, 0, cmax - 1)),
+    ).astype(jnp.int32)
+
+    child, _, _ = _structure_from_arity(arity, need_depth=False)
+    code_slot = jnp.where(
+        arity == 2, 1 + op,
+        jnp.where(arity == 1, 1 + n_binary + op, 0),
+    ).astype(jnp.int32)
+    src1_slot = jnp.take_along_axis(addr, child[..., 0], axis=-1)
+    src2_slot = jnp.where(
+        arity == 2, jnp.take_along_axis(addr, child[..., 1], axis=-1),
+        src1_slot,
+    )
+
+    # Compress: internal slots first, in postfix order (keys are unique).
+    order = jnp.argsort(jnp.where(internal, slot[None, :], L + slot[None, :]),
+                        axis=-1)
+    code = jnp.take_along_axis(code_slot, order, axis=-1)
+    src1 = jnp.take_along_axis(src1_slot, order, axis=-1)
+    src2 = jnp.take_along_axis(src2_slot, order, axis=-1)
+
+    m = jnp.sum(internal, axis=-1)
+    root_slot = jnp.clip(length - 1, 0, L - 1)
+    root_addr = jnp.take_along_axis(addr, root_slot[:, None], axis=-1)[:, 0]
+    leaf_only = m == 0
+    code = code.at[:, 0].set(jnp.where(leaf_only, 0, code[:, 0]))
+    src1 = src1.at[:, 0].set(jnp.where(leaf_only, root_addr, src1[:, 0]))
+    src2 = src2.at[:, 0].set(jnp.where(leaf_only, root_addr, src2[:, 0]))
+    nsteps = jnp.maximum(m, 1).astype(jnp.int32)
+
+    # Constant-leaf table, gather-only (XLA scatters lower poorly on TPU):
+    # a second argsort lists const-leaf slots first in slot order.
+    nconst = jnp.sum(is_cleaf, axis=-1).astype(jnp.int32)
+    order_c = jnp.argsort(
+        jnp.where(is_cleaf, slot[None, :], L + slot[None, :]), axis=-1)
+    used = jnp.arange(cmax, dtype=jnp.int32)[None, :] < nconst[:, None]
+    cslot = jnp.where(used, order_c[:, :cmax], L).astype(jnp.int32)
+    cvals = jnp.where(
+        used,
+        jnp.take_along_axis(const, jnp.clip(cslot, 0, L - 1), axis=-1),
+        0.0,
+    ).astype(const.dtype)
+    const_ok = jnp.all(jnp.isfinite(const) | ~is_cleaf, axis=-1)
+
+    return TreeProgram(code=code, src1=src1, src2=src2, nsteps=nsteps,
+                       cvals=cvals, cslot=cslot, nconst=nconst,
+                       const_ok=const_ok)
+
+
+def update_consts(prog: TreeProgram, const: jax.Array) -> TreeProgram:
+    """Re-bind a program to new constant vectors ``const`` [T, L].
+
+    Structure fields are reused untouched — this is the hoisted path for
+    line searches / optimizer iterations where only constants move.
+    """
+    L = const.shape[-1]
+    used = prog.cslot < L
+    gathered = jnp.take_along_axis(
+        const, jnp.clip(prog.cslot, 0, L - 1), axis=-1)
+    cvals = jnp.where(used, gathered, 0.0).astype(const.dtype)
+    const_ok = jnp.all(jnp.isfinite(gathered) | ~used, axis=-1)
+    return dataclasses.replace(prog, cvals=cvals, const_ok=const_ok)
+
+
+def const_mask_compressed(prog: TreeProgram) -> jax.Array:
+    """[T, CMAX] float mask of used constant slots."""
+    return (prog.cslot < prog.max_steps).astype(prog.cvals.dtype)
+
+
+def scatter_const_grads(prog: TreeProgram, gcomp: jax.Array,
+                        max_nodes: int) -> jax.Array:
+    """Scatter compressed per-constant gradients [T, CMAX] → [T, L]."""
+    T = gcomp.shape[0]
+    out = jnp.zeros((T, max_nodes), gcomp.dtype)
+    return out.at[jnp.arange(T)[:, None], prog.cslot].add(gcomp, mode="drop")

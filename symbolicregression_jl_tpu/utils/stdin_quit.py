@@ -55,16 +55,47 @@ class StdinQuitWatcher:
         self.quit = False
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
+        self._saved_termios = None
         try:
             interactive = force or self.stream.isatty()
         except (AttributeError, ValueError):
             interactive = False
         self.active = bool(interactive)
         if self.active:
+            self._enter_cbreak()
             self._thread = threading.Thread(
                 target=_watch_loop, args=(weakref.ref(self),), daemon=True
             )
             self._thread.start()
+
+    def _enter_cbreak(self) -> None:
+        """Disable line buffering on a real TTY so a bare 'q' registers
+        without Enter (the reference switches its terminal to raw mode,
+        src/SearchUtils.jl:342-349); restored by stop(). Injected test
+        streams and pipes have no termios and are left alone."""
+        try:
+            import termios
+            import tty
+
+            fd = self.stream.fileno()
+            if not self.stream.isatty():
+                return
+            self._saved_termios = (fd, termios.tcgetattr(fd))
+            tty.setcbreak(fd)
+        except Exception:  # no tty/termios: stay line-buffered
+            self._saved_termios = None
+
+    def _restore_tty(self) -> None:
+        if self._saved_termios is None:
+            return
+        fd, attrs = self._saved_termios
+        self._saved_termios = None
+        try:
+            import termios
+
+            termios.tcsetattr(fd, termios.TCSADRAIN, attrs)
+        except Exception:
+            pass
 
     def _readable(self, timeout: float) -> bool:
         """Poll the stream for input so the thread can exit on stop();
@@ -84,11 +115,13 @@ class StdinQuitWatcher:
     def stop(self) -> None:
         """End the watcher thread (called when the search finishes —
         otherwise a stale thread would keep consuming stdin characters
-        meant for a later search)."""
+        meant for a later search) and restore the terminal mode."""
         self._stopped = True
+        self._restore_tty()
 
     def __del__(self):  # backstop for exception paths
         self._stopped = True
+        self._restore_tty()
 
     def check(self) -> bool:
         """True when the user asked to quit (check_for_user_quit,

@@ -118,6 +118,47 @@ def test_fused_batch_dims(setup):
     )
 
 
+def test_fused_loss_multi_matches_replication(setup):
+    """The multi-variant kernel == fused_loss on per-variant replicas
+    (the line-search fast path must not change any loss value)."""
+    from symbolicregression_jl_tpu.ops.fused_eval import fused_loss_multi
+    from symbolicregression_jl_tpu.ops.program import compile_program
+
+    opts, cfg, X, y = setup
+    opset = cfg.operators
+    trees = init_population(jax.random.PRNGKey(7), 6, cfg.mctx, jnp.float32)
+    F = X.shape[0]
+    prog = compile_program(trees, F, len(opset.binary))
+    V = 5
+    rng = np.random.default_rng(2)
+    cvals_v = jnp.asarray(
+        np.asarray(prog.cvals)[:, None, :]
+        * (1.0 + rng.normal(0, 0.7, (6, V, prog.cmax)).astype(np.float32))
+    )
+    # one variant gets a non-finite constant -> must come back invalid
+    # (only if that tree actually has constants)
+    cvals_v = cvals_v.at[0, 2, 0].set(jnp.inf)
+    l_multi, v_multi = fused_loss_multi(
+        prog, cvals_v, X, y, None, F, opset, l2_dist_loss, interpret=True
+    )
+    assert l_multi.shape == (6, V)
+    # reference: plain fused_loss on trees with constants scattered back
+    import dataclasses as dc
+    for v in range(V):
+        const_v = trees.const.at[
+            jnp.arange(6)[:, None], prog.cslot
+        ].set(cvals_v[:, v, :], mode="drop")
+        tr_v = dc.replace(trees, const=const_v)
+        l_ref, v_ref = fused_loss(
+            tr_v, X, y, None, opset, l2_dist_loss, interpret=True
+        )
+        assert np.array_equal(np.asarray(v_ref), np.asarray(v_multi[:, v]))
+        ok = np.isfinite(np.asarray(l_ref))
+        assert np.allclose(np.asarray(l_ref)[ok],
+                           np.asarray(l_multi[:, v])[ok], rtol=1e-5)
+        assert np.all(np.isinf(np.asarray(l_multi[:, v])[~ok]))
+
+
 def test_fused_constant_optimizer(setup):
     """Fused batched-line-search BFGS recovers known constants
     (optimize_constants semantics, src/ConstantOptimization.jl:29-113)."""
