@@ -1,0 +1,236 @@
+"""Search-QUALITY benchmark: loss-vs-wall-clock Pareto fronts, TPU vs CPU.
+
+Throughput (bench.py) says how fast evals run; this harness asks whether
+the searches *find equally good equations per unit wall-clock*. It runs
+the same engine (same algorithm, same options) on the TPU backend
+(turbo Pallas kernels) and on the multithreaded XLA CPU backend (jnp
+interpreter path — the measured-CPU reference point from
+profiling/cpu_baseline.py / BASELINE.md), over:
+
+- the reference benchmark problem
+  (/root/reference/benchmark/benchmarks.jl:11-33: n=1000 rows, 5
+  features, ops {+,-,*,/} ∪ {exp,abs}, maxsize=30, target
+  cos(2.13x₁)+0.5x₂|x₃|^0.9−0.3|x₄|^1.5 + 0.1·noise), and
+- a 10-problem Feynman-style suite (2-5 variables, physics forms).
+
+Each run gets a fixed wall-clock budget (compile excluded via one warmup
+iteration at identical shapes) and N seeds; after every iteration the
+harness records (elapsed, best_loss, pareto front). Results aggregate to
+``profiling/quality_results.json``; BASELINE.md summarizes.
+
+Usage:
+  python profiling/quality_bench.py --run PROBLEM PLATFORM SEED BUDGET
+      (single run; prints one JSON line — used via subprocess so each
+       run gets a fresh process pinned to its backend)
+  python profiling/quality_bench.py --suite [--budget-bench 60]
+      [--budget-feynman 40] [--seeds-bench 4] [--seeds-feynman 2]
+      (full matrix -> profiling/quality_results.json)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+DEFAULT_OPS = dict(binary_operators=["+", "-", "*", "/"],
+                   unary_operators=["exp", "abs"])
+FEYNMAN_OPS = dict(binary_operators=["+", "-", "*", "/"],
+                   unary_operators=["sin", "cos", "exp", "sqrt"])
+
+
+def _bench_problem(rng):
+    X = rng.uniform(-3.0, 3.0, (1000, 5)).astype(np.float32)
+    y = (np.cos(2.13 * X[:, 0])
+         + 0.5 * X[:, 1] * np.abs(X[:, 2]) ** 0.9
+         - 0.3 * np.abs(X[:, 3]) ** 1.5
+         + 0.1 * rng.standard_normal(1000)).astype(np.float32)
+    return X, y, DEFAULT_OPS
+
+
+# (name, n_vars, fn, sampling range) — Feynman-style physics forms
+FEYNMAN = {
+    "gauss": (1, lambda x: np.exp(-x[0] ** 2 / 2) / np.sqrt(2 * np.pi),
+              (-3, 3)),
+    "dist": (4, lambda x: np.sqrt((x[0] - x[1]) ** 2 + (x[2] - x[3]) ** 2),
+             (-2, 2)),
+    "relmass": (2, lambda x: x[0] / np.sqrt(1 - (0.3 * x[1]) ** 2), (0.1, 2)),
+    "lorentz": (5, lambda x: x[0] * (x[1] + x[2] * x[3] * np.sin(x[4])),
+                (-1, 1)),
+    "gravpot": (4, lambda x: x[0] * x[1] * (1 / x[3] - 1 / x[2]), (0.5, 3)),
+    "veladd": (2, lambda x: (x[0] + x[1]) / (1 + x[0] * x[1] * 0.25),
+               (-1, 1)),
+    "coulomb": (3, lambda x: x[0] * x[1] / (4 * np.pi * x[2] ** 2),
+                (0.5, 3)),
+    "pendulum": (3, lambda x: x[0] * np.cos(x[1] * x[2]), (0.3, 2)),
+    "ideal_gas": (4, lambda x: x[0] * x[1] * x[2] / x[3], (0.5, 3)),
+    "decay": (2, lambda x: np.exp(-x[0] * x[1]), (0.1, 2)),
+}
+
+
+def _feynman_problem(name, rng):
+    nv, fn, (lo, hi) = FEYNMAN[name]
+    X = rng.uniform(lo, hi, (1000, nv)).astype(np.float32)
+    y = fn(X.T).astype(np.float32)
+    return X, y, FEYNMAN_OPS
+
+
+def single_run(problem: str, platform: str, seed: int, budget_s: float):
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from symbolicregression_jl_tpu import Options, search_key
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+
+    rng = np.random.default_rng(1234)  # same data for every seed/platform
+    if problem == "bench":
+        X, y, ops = _bench_problem(rng)
+    else:
+        X, y, ops = _feynman_problem(problem, rng)
+
+    options = Options(
+        maxsize=30, populations=31, population_size=27,
+        ncycles_per_iteration=380, save_to_file=False, **ops,
+    )
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+    engine = Engine(options, ds.nfeatures)
+    state = engine.init_state(search_key(seed), ds.data, options.populations)
+
+    # warmup = compile at final shapes (excluded from the budget: both
+    # platforms pay XLA compile once per config, and the comparison is
+    # about search progress, not compile latency)
+    state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+
+    curve = []
+    t0 = time.perf_counter()
+    while True:
+        state = engine.run_iteration(state, ds.data, options.maxsize)
+        jax.block_until_ready(state.pops.cost)
+        el = time.perf_counter() - t0
+        loss = np.asarray(state.pops.loss).ravel()
+        cx = np.asarray(state.pops.complexity).ravel()
+        finite = np.isfinite(loss)
+        best = float(loss[finite].min()) if finite.any() else float("inf")
+        curve.append([round(el, 2), best])
+        if el >= budget_s:
+            break
+
+    # final pareto front: min loss per complexity, dominated points culled
+    front = {}
+    for c, l in zip(cx[finite], loss[finite]):
+        c = int(c)
+        if c not in front or l < front[c]:
+            front[c] = float(l)
+    pareto, best_so_far = [], float("inf")
+    for c in sorted(front):
+        if front[c] < best_so_far:
+            best_so_far = front[c]
+            pareto.append([c, front[c]])
+
+    print(json.dumps({
+        "problem": problem, "platform": platform, "seed": seed,
+        "budget_s": budget_s, "iters": len(curve),
+        "num_evals": float(state.num_evals),
+        "best_loss": curve[-1][1] if curve else float("inf"),
+        "curve": curve, "front": pareto,
+    }))
+
+
+def suite(args):
+    here = os.path.abspath(__file__)
+    runs = []
+    for seed in range(args.seeds_bench):
+        for plat in ("tpu", "cpu"):
+            runs.append(("bench", plat, seed, args.budget_bench))
+    for name in FEYNMAN:
+        for seed in range(args.seeds_feynman):
+            for plat in ("tpu", "cpu"):
+                runs.append((name, plat, seed, args.budget_feynman))
+
+    results = []
+    for problem, plat, seed, budget in runs:
+        cmd = [sys.executable, here, "--run", problem, plat, str(seed),
+               str(budget)]
+        t0 = time.time()
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=budget * 6 + 600)
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            rec = {"problem": problem, "platform": plat, "seed": seed,
+                   "error": out.stderr[-500:]}
+        rec["wall_s"] = round(time.time() - t0, 1)
+        results.append(rec)
+        print(f"{problem:10s} {plat:4s} seed={seed}: "
+              f"best={rec.get('best_loss', 'ERR')}", flush=True)
+
+    # summary: per problem, median best loss per platform + win fraction
+    summary = {}
+    for problem in ["bench"] + list(FEYNMAN):
+        rows = [r for r in results if r.get("problem") == problem
+                and "best_loss" in r]
+        med = {}
+        for plat in ("tpu", "cpu"):
+            ls = sorted(r["best_loss"] for r in rows
+                        if r["platform"] == plat)
+            med[plat] = ls[len(ls) // 2] if ls else None
+        wins = ties = 0
+        seeds = {r["seed"] for r in rows}
+        for s in seeds:
+            t = next((r["best_loss"] for r in rows
+                      if r["platform"] == "tpu" and r["seed"] == s), None)
+            c = next((r["best_loss"] for r in rows
+                      if r["platform"] == "cpu" and r["seed"] == s), None)
+            if t is None or c is None:
+                continue
+            if t <= c * 1.05:
+                wins += 1  # within 5% or better counts as not-worse
+            if abs(t - c) <= 0.05 * max(abs(c), 1e-12):
+                ties += 1
+        summary[problem] = {"median_best": med,
+                            "tpu_not_worse": wins, "n_seeds": len(seeds)}
+
+    out_path = os.path.join(os.path.dirname(here), "quality_results.json")
+    with open(out_path, "w") as f:
+        json.dump({"runs": results, "summary": summary,
+                   "config": vars(args)}, f, indent=1)
+    print("wrote", out_path)
+    for k, v in summary.items():
+        print(f"  {k:10s} median tpu={v['median_best']['tpu']} "
+              f"cpu={v['median_best']['cpu']} "
+              f"tpu_not_worse={v['tpu_not_worse']}/{v['n_seeds']}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run", nargs=4, metavar=("PROBLEM", "PLplatform",
+                                               "SEED", "BUDGET"))
+    ap.add_argument("--suite", action="store_true")
+    ap.add_argument("--budget-bench", type=float, default=60.0)
+    ap.add_argument("--budget-feynman", type=float, default=40.0)
+    ap.add_argument("--seeds-bench", type=int, default=4)
+    ap.add_argument("--seeds-feynman", type=int, default=2)
+    args = ap.parse_args()
+    if args.run:
+        problem, plat, seed, budget = args.run
+        single_run(problem, plat, int(seed), float(budget))
+    elif args.suite:
+        suite(args)
+    else:
+        print(__doc__)
+
+
+if __name__ == "__main__":
+    main()

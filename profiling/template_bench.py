@@ -77,7 +77,7 @@ def main():
     jax.block_until_ready(out)
     eval_rate = T * 10 / (time.perf_counter() - t0)
 
-    # (b) short search evals/s
+    # (b) short search evals/s (historic 16x33 config)
     state = engine.init_state(sr.search_key(0), ds.data, options.populations)
     state = engine.run_iteration(state, ds.data, options.maxsize)
     jax.block_until_ready(state.pops.cost)
@@ -88,10 +88,40 @@ def main():
     jax.block_until_ready(state.pops.cost)
     search_rate = (float(state.num_evals) - ev0) / (time.perf_counter() - t0)
 
+    # (c) template-vs-plain ratio at an IDENTICAL island-scaled config —
+    # the round-2 "45% of plain search" number compared a 16x33 template
+    # search against the 256x256 plain bench, which mostly measured
+    # config scale, not template overhead.
+    def search_rate_at(spec_arg):
+        opts = sr.Options(
+            binary_operators=["+", "-", "*"],
+            unary_operators=["cos"],
+            maxsize=20, populations=64, population_size=64,
+            tournament_selection_n=8, ncycles_per_iteration=40,
+            expression_spec=spec_arg, save_to_file=False,
+        )
+        eng = Engine(opts, ds.nfeatures,
+                     template=(st if spec_arg is not None else None))
+        s0 = eng.init_state(sr.search_key(0), ds.data, opts.populations)
+        s0 = eng.run_iteration(s0, ds.data, opts.maxsize)
+        jax.block_until_ready(s0.pops.cost)
+        e0 = float(s0.num_evals)
+        t0 = time.perf_counter()
+        for _ in range(2):
+            s0 = eng.run_iteration(s0, ds.data, opts.maxsize)
+        jax.block_until_ready(s0.pops.cost)
+        return (float(s0.num_evals) - e0) / (time.perf_counter() - t0)
+
+    tmpl_64 = search_rate_at(spec)
+    plain_64 = search_rate_at(None)
+
     print(json.dumps({
         "metric": "template_config5_eval_and_search",
         "template_eval_members_per_sec_10k_rows": round(eval_rate, 1),
         "template_search_evals_per_sec_10k_rows": round(search_rate, 1),
+        "template_search_64x64": round(tmpl_64, 1),
+        "plain_search_64x64": round(plain_64, 1),
+        "template_over_plain_same_config": round(tmpl_64 / plain_64, 3),
     }))
 
 

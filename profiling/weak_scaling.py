@@ -1,0 +1,77 @@
+"""Weak-scaling bench: islands proportional to devices, evals/s/device.
+
+Ready for real multi-chip hardware (this machine exposes one tunneled
+v5e chip, so today it can only demonstrate the 1-device point on TPU and
+the scaling *shape* on a virtual CPU mesh). Per scale it runs the bench
+problem with ``islands = islands_per_device * n_devices`` sharded over
+the island mesh axis and reports full-dataset evals/s and
+evals/s/device — flat evals/s/device = ideal weak scaling, since
+islands are data-independent (migration is the only ICI traffic).
+
+Usage:
+  python profiling/weak_scaling.py                 # all device counts 1..N
+  python profiling/weak_scaling.py --islands 64    # islands per device
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from _common import make_bench_problem  # noqa: F401 (sys.path setup)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--islands", type=int, default=64,
+                    help="islands per device")
+    ap.add_argument("--population-size", type=int, default=128)
+    ap.add_argument("--ncycles", type=int, default=50)
+    args = ap.parse_args()
+
+    import jax
+
+    from symbolicregression_jl_tpu import search_key
+    from symbolicregression_jl_tpu.parallel.mesh import (
+        make_mesh,
+        shard_device_data,
+        shard_search_state,
+    )
+
+    devices = jax.devices()
+    counts = [n for n in (1, 2, 4, 8, 16, 32) if n <= len(devices)]
+    results = []
+    for n in counts:
+        I = args.islands * n
+        options, ds, engine = make_bench_problem(
+            populations=I, population_size=args.population_size,
+            tournament_selection_n=8,
+            ncycles_per_iteration=args.ncycles,
+        )
+        mesh = make_mesh(devices[:n], n_island_shards=n, n_data_shards=1)
+        data = shard_device_data(ds.data, mesh)
+        state = engine.init_state(search_key(0), data, I)
+        state = shard_search_state(state, mesh)
+        state = engine.run_iteration(state, data, options.maxsize)
+        jax.block_until_ready(state.pops.cost)
+        ev0 = float(state.num_evals)
+        t0 = time.perf_counter()
+        for _ in range(2):
+            state = engine.run_iteration(state, data, options.maxsize)
+        jax.block_until_ready(state.pops.cost)
+        dt = time.perf_counter() - t0
+        rate = (float(state.num_evals) - ev0) / dt
+        results.append({
+            "devices": n, "islands": I, "evals_per_sec": round(rate, 1),
+            "evals_per_sec_per_device": round(rate / n, 1),
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+    print(json.dumps({"metric": "weak_scaling_islands_per_device",
+                      "islands_per_device": args.islands,
+                      "points": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -62,11 +62,12 @@ def __getattr__(name):
         "eval_diff_tree_array",
         "eval_grad_tree_array",
         "differentiable_eval_tree_array",
-        "D",
     ):
         from .ops import diff
 
         return getattr(diff, name)
+    if name == "D":
+        return _dispatch_D
     if name in ("SRRegressor", "MultitargetSRRegressor"):
         from .api import regressor
 
@@ -76,3 +77,23 @@ def __getattr__(name):
 
         return getattr(models, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def _dispatch_D(obj, arg=1):
+    """The exported ``D`` (reference src/SymbolicRegression.jl:172).
+
+    - On a host ``Node``: symbolic derivative w.r.t. variable index
+      ``arg`` (0-based feature, ops.diff.D semantics).
+    - On template/composable subexpression callables: a derivative
+      callable w.r.t. argument slot ``arg`` (1-based, matching the
+      reference's template idiom ``D(V, 1)(x)``); see models.template.D.
+    """
+    from .ops.tree import Node
+
+    if isinstance(obj, Node):
+        from .ops import diff
+
+        return diff.D(obj, arg)
+    from .models import template as _template
+
+    return _template.D(obj, arg)

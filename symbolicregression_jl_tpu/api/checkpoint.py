@@ -59,11 +59,7 @@ def options_compat_header(options: "Options") -> dict:
     if spec is not None and hasattr(spec, "structure"):
         fn = spec.structure.combine
         code = getattr(fn, "__code__", None)
-        digest = None
-        if code is not None:
-            h = hashlib.sha1(code.co_code)
-            h.update(repr(code.co_consts).encode())  # literals differ too
-            digest = h.hexdigest()[:16]
+        digest = _code_digest(code) if code is not None else None
         fp = (getattr(fn, "__qualname__", repr(fn)), digest)
     # Field list comes from the same source as the in-memory warm-start
     # check (Options._WARM_START_FIELDS) so the two can't drift — for
@@ -81,6 +77,21 @@ def options_compat_header(options: "Options") -> dict:
     header["expression_spec"] = spec_desc
     header["template_combiner_fp"] = fp
     return header
+
+
+def _code_digest(code) -> str:
+    """Process-stable digest of a code object.
+
+    Recurses into nested code objects in co_consts (lambdas, genexprs):
+    their repr embeds a memory address, which would make every resume
+    look like a changed combiner."""
+    h = hashlib.sha1(code.co_code)
+    for c in code.co_consts:
+        if hasattr(c, "co_code"):
+            h.update(_code_digest(c).encode())
+        else:
+            h.update(repr(c).encode())
+    return h.hexdigest()[:16]
 
 
 _KNOWN_KEY_IMPLS = ("threefry2x32", "rbg", "unsafe_rbg")

@@ -14,7 +14,6 @@ path serves all arities.
 
 from __future__ import annotations
 
-import dataclasses
 from typing import NamedTuple, Optional
 
 import jax

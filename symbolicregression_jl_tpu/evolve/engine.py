@@ -472,7 +472,13 @@ class Engine:
                             k, sub, g, data, el_loss, cfg.operators,
                             self.opt_cfg, cfg.template,
                             batch_idx=batch_idx, params=sub_p,
-                            fused=cfg.turbo, interpret=cfg.interpret,
+                            # D call sites need second-order AD (grad of
+                            # the derivative); the fused kernels' custom
+                            # VJP is first-order only, so those
+                            # structures optimize on the jvp-composable
+                            # interpreter path.
+                            fused=cfg.turbo and not cfg.template.uses_deriv,
+                            interpret=cfg.interpret,
                         )
                 else:
                     def island_opt(k, trees: TreeBatch, idx, g, p):

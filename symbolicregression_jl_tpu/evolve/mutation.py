@@ -31,6 +31,7 @@ from ..ops.encoding import (
     MAX_ARITY,
     TreeBatch,
     _tree_structure_single,
+    lane_take,
 )
 from .pieces import combine_sources, concat_pieces, splice_span
 from .rng import (
@@ -559,7 +560,7 @@ def _random_postfix_from_counts(u, n_binary, n_unary, ctx: MutationContext,
     ).astype(jnp.int32)
     prio = jnp.where(live, s.take(L), 2.0)
     perm = jnp.argsort(prio)
-    arity = jnp.where(live, vals[perm], 0)
+    arity = jnp.where(live, lane_take(vals, perm), 0)
 
     # cycle-lemma rotation (dead slots get +inf so they never win the min)
     S = jnp.cumsum(1 - arity)
@@ -568,7 +569,7 @@ def _random_postfix_from_counts(u, n_binary, n_unary, ctx: MutationContext,
     t = jnp.max(jnp.where(S_masked == minS, slot, -1))   # last argmin
     p = jnp.where(t + 1 >= m, 0, t + 1)
     src = jnp.where(live, (p + slot) % jnp.maximum(m, 1), slot)
-    arity = jnp.where(live, arity[src], 0)
+    arity = jnp.where(live, lane_take(arity, src), 0)
 
     # operator indices per arity
     nuna = ctx.nops[0] if len(ctx.nops) >= 1 else 0

@@ -19,6 +19,7 @@ from ..ops.encoding import (
     MAX_ARITY,
     TreeBatch,
     _tree_structure_single,
+    lane_take,
 )
 from ..ops.eval import eval_single_tree
 
@@ -49,9 +50,12 @@ def _fold_single(tree: TreeBatch, X1, operators):
         stack = stack.at[new_sp - 1].set(c_k)
         return (stack, new_sp), c_k
 
+    # unroll=4 (not full): a fully-unrolled scan fuses into one kLoop
+    # whose live set exceeds XLA's scoped-VMEM budget when vmapped over
+    # whole populations.
     (_, _), is_const = jax.lax.scan(
         step, (jnp.zeros((L,), jnp.bool_), jnp.int32(0)),
-        jnp.arange(L, dtype=jnp.int32), unroll=True,
+        jnp.arange(L, dtype=jnp.int32), unroll=4,
     )
 
     # Node values on the dummy row: const-subtree values are X-independent.
@@ -78,28 +82,32 @@ def _fold_single(tree: TreeBatch, X1, operators):
 
     (buf,), _ = jax.lax.scan(
         eval_step, (jnp.zeros((L, 1), tree.const.dtype),),
-        jnp.arange(L, dtype=jnp.int32), unroll=True,
+        jnp.arange(L, dtype=jnp.int32), unroll=4,
     )
     values = buf[:, 0]
 
-    # parent const-ness: a node is *inside* a folded subtree if any ancestor
-    # is const. Equivalent: node k is kept iff it is not a strict descendant
-    # of a const-subtree root. Using spans: k is a descendant of m iff
-    # m - size[m] < k < m. Compute "covered" via a reverse sweep: mark const
-    # roots (const node whose parent is not const); then a node is dropped
-    # iff it lies strictly inside some const root's span.
-    parent_const = jnp.zeros((L,), jnp.bool_)
-    # parent pointer: parent[c] = k for each child c of k
-    parent = jnp.full((L,), -1, jnp.int32)
-    for j in range(MAX_ARITY):
-        is_child = (jnp.arange(MAX_ARITY)[j] < tree.arity) & in_tree
-        parent = parent.at[jnp.where(is_child, child[:, j], L)].set(
-            slot, mode="drop"
+    # A node is *inside* a folded subtree iff some LATER const node's
+    # span contains it (postfix: ancestors come after descendants, and
+    # const-ness is subtree-contiguous, so "parent is const" ⟺ "covered
+    # by any const node's strict span"). covered[c] = ∃ k > c with
+    # is_const[k] and start_k <= c — an O(L) exclusive suffix-min of the
+    # const spans' starts (no parent pointers, no [L, L] intermediates,
+    # which blew XLA's scoped-VMEM budget when vmapped over whole
+    # populations).
+    BIG = jnp.int32(L + 1)
+    start = (slot - size + 1).astype(jnp.int32)
+    vals = jnp.where(is_const & in_tree, start, BIG)
+    # exclusive suffix-min by doubling shifts (log L slice+min passes —
+    # keeps the lowering to plain vector ops)
+    m_excl = jnp.concatenate([vals[1:], jnp.full((1,), BIG)])
+    sh = 1
+    while sh < L:
+        m_excl = jnp.minimum(
+            m_excl,
+            jnp.concatenate([m_excl[sh:], jnp.full((sh,), BIG)]),
         )
-    has_parent = parent >= 0
-    parent_is_const = jnp.where(
-        has_parent, is_const[jnp.clip(parent, 0, L - 1)], False
-    )
+        sh *= 2
+    parent_is_const = m_excl <= slot
     is_fold_root = is_const & ~parent_is_const & in_tree
     keep = in_tree & (~is_const | is_fold_root)
 
@@ -107,7 +115,7 @@ def _fold_single(tree: TreeBatch, X1, operators):
     new_len = jnp.sum(keep.astype(jnp.int32))
     order_key = jnp.where(keep, slot, L + slot)  # kept first, stable
     perm = jnp.argsort(order_key)
-    g = lambda x: x[perm]
+    g = lambda x: lane_take(x, perm)
     folded_to_leaf = is_fold_root & (tree.arity > 0)
     arity = jnp.where(folded_to_leaf, 0, tree.arity)
     op = jnp.where(folded_to_leaf, LEAF_CONST, tree.op)

@@ -441,6 +441,53 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
 # ---------------------------------------------------------------------------
 
 
+def _member_take_onehot(pop: PopulationState, idx: jax.Array, P: int
+                        ) -> PopulationState:
+    """Batched ``pop.member(idx[b])`` for all slots at once.
+
+    Tree fields ([P, L] or [P, K, L]) gather via a [B, P] one-hot matmul
+    (MXU) — XLA's per-lane gather lowering serialized the vmapped
+    ``jnp.take`` into a measurable per-cycle cost. Small [P] metadata
+    vectors keep plain ``jnp.take``; lineage ids (birth/ref/parent) can
+    exceed f32's exact-integer range on long runs, so they must not ride
+    the float matmul.
+    """
+    oh = jax.nn.one_hot(idx, P, dtype=pop.trees.const.dtype)  # [B, P]
+    B = idx.shape[0]
+
+    def take_tree_i(x):
+        out = jnp.round(oh @ x.reshape(P, -1).astype(oh.dtype))
+        return out.astype(x.dtype).reshape((B,) + x.shape[1:])
+
+    def take_tree_f(x):
+        # 0 * inf = NaN would leak a single member's overflowed constant
+        # into EVERY selected parent through the matmul; clamp source
+        # non-finites to a huge finite value first — the affected
+        # member's own evals overflow to invalid either way, everyone
+        # else's rows are exact.
+        xf = x.reshape(P, -1).astype(oh.dtype)
+        xf = jnp.nan_to_num(xf, nan=3.0e38, posinf=3.0e38, neginf=-3.0e38)
+        return (oh @ xf).astype(x.dtype).reshape((B,) + x.shape[1:])
+
+    take = lambda x: jnp.take(x, idx, axis=0)
+    return PopulationState(
+        trees=TreeBatch(
+            arity=take_tree_i(pop.trees.arity),
+            op=take_tree_i(pop.trees.op),
+            feat=take_tree_i(pop.trees.feat),
+            const=take_tree_f(pop.trees.const),
+            length=take_tree_i(pop.trees.length),
+        ),
+        cost=take(pop.cost),
+        loss=take(pop.loss),
+        complexity=take(pop.complexity),
+        birth=take(pop.birth),
+        ref=take(pop.ref),
+        parent=take(pop.parent),
+        params=take_tree_f(pop.params),
+    )
+
+
 def generation_step(
     key,
     pop: PopulationState,
@@ -490,15 +537,21 @@ def generation_step(
     # one bulk uniform draw covers every non-tournament decision of a slot
     SLOT_NU = 1 + NKINDS + TK + A * ATT_NU + A * L2 + 1 + 1 + 4
 
-    def slot_fn(k):
-        kt1, kt2, ku = jax.random.split(k, 3)
-        u = jax.random.uniform(ku, (SLOT_NU,))
+    # Tournaments + parent gathers hoisted OUT of the slot vmap: a
+    # vmapped `jnp.take` over the member axis lowers to a serialized
+    # custom gather (~3.4 ms/cycle at the bench config); batching all B
+    # slots' parents into one one-hot matmul per field rides the MXU
+    # instead. RNG stream layout (split(k, 3) per slot) is unchanged.
+    slot_keys3 = jax.vmap(lambda k: jax.random.split(k, 3))(keys)  # [B, 3, 2]
+    i1 = jax.vmap(tourney)(slot_keys3[:, 0])
+    i2 = jax.vmap(tourney)(slot_keys3[:, 1])
+    m1_all = _member_take_onehot(pop, i1, P)
+    m2_all = _member_take_onehot(pop, i2, P)
+
+    def slot_fn(ku_key, i1, i2, m1, m2):
+        u = jax.random.uniform(ku_key, (SLOT_NU,))
         s = USlice(u)
         is_xover = u_bernoulli(s.take1(), cfg.crossover_probability)
-        i1 = tourney(kt1)
-        i2 = tourney(kt2)
-        m1 = pop.member(i1)
-        m2 = pop.member(i2)
 
         base_w = jnp.asarray(options.mutation_weights.as_vector(), jnp.float32)
         if cfg.template is not None:
@@ -614,7 +667,8 @@ def generation_step(
 
     (is_xover, i1, i2, kind, immediate, mut_success, xo_success,
      cand1, cand2, cand1_params, cand2_params,
-     needs_eval1, needs_eval2, accept_u) = jax.vmap(slot_fn)(keys)
+     needs_eval1, needs_eval2, accept_u) = jax.vmap(slot_fn)(
+        slot_keys3[:, 2], i1, i2, m1_all, m2_all)
 
     # ---- one fused eval launch over all candidates ----
     # cand2 (crossover's second child) matters only on crossover slots —

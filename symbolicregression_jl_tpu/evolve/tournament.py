@@ -11,6 +11,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..ops.encoding import lane_take
+
 __all__ = ["tournament_select"]
 
 
@@ -30,22 +32,26 @@ def tournament_select(
     P = cost.shape[0]
     k1, k2 = jax.random.split(key)
     picks = jax.random.permutation(k1, P)[:tournament_n]
-    c = cost[picks]
+    # lane_take everywhere: these [n]-from-[P] gathers are vmapped over
+    # (island, slot) and XLA's per-lane gather lowering serialized them
+    # into a visible per-cycle cost (see ops.encoding.lane_take).
+    c = lane_take(cost, picks)
     if use_frequency:
-        size = complexity[picks]
+        size = lane_take(complexity, picks)
         in_range = (size > 0) & (size <= maxsize)
         freq = jnp.where(
             in_range,
-            normalized_frequencies[jnp.clip(size - 1, 0, maxsize - 1)],
+            lane_take(normalized_frequencies,
+                      jnp.clip(size - 1, 0, maxsize - 1)),
             0.0,
         )
         c = c * jnp.exp(adaptive_parsimony_scaling * freq).astype(c.dtype)
     # NaN costs must never win a tournament:
     c = jnp.where(jnp.isnan(c), jnp.inf, c)
     if p >= 1.0:
-        return picks[jnp.argmin(c)]
+        return lane_take(picks, jnp.argmin(c)[None])[0]
     ks = jnp.arange(tournament_n)
     place_weights = p * (1 - p) ** ks
     place = jax.random.categorical(k2, jnp.log(place_weights))
     order = jnp.argsort(c)
-    return picks[order[place]]
+    return lane_take(picks, lane_take(order, place[None]))[0]

@@ -209,6 +209,40 @@ class ComposableExpression:
             return self._compose(args)
         return self._evaluate(args)
 
+    def derivative(self, argnum: int = 1) -> "ComposableExpression":
+        """Symbolic row-wise derivative w.r.t. argument slot ``argnum``
+        (1-based) — the host-side face of the template ``D`` operator.
+
+        Returns a new ComposableExpression of the same arity whose tree
+        is the simplified symbolic derivative (ops.diff.D). Derivative
+        rules can introduce operators outside the original set (e.g.
+        ``neg``/``sin`` from d cos); the result carries an operator set
+        extended with whatever the derivative tree needs."""
+        from ..ops.diff import D as symbolic_D
+        from ..ops.operators import OperatorSet
+
+        if not 1 <= argnum <= max(self.nfeatures, 1):
+            raise ValueError(
+                f"derivative argnum {argnum} out of range "
+                f"1..{self.nfeatures}"
+            )
+        dtree = symbolic_D(self.tree, argnum - 1)
+        have = {(op.name, d)
+                for d, ops_d in self.operators.ops.items() for op in ops_d}
+        need = {(n.op, n.degree) for n in dtree.nodes() if n.degree > 0}
+        operators = self.operators
+        missing = [(op, d) for op, d in need if (op.name, d) not in have]
+        if missing:
+            # Extend with the derivative rules' Op OBJECTS (not names —
+            # custom operators in self.operators aren't in the registry).
+            by_arity = {d: list(ops_d)
+                        for d, ops_d in self.operators.ops.items()}
+            for op, d in sorted(missing, key=lambda t: (t[1], t[0].name)):
+                by_arity.setdefault(d, []).append(op)
+            operators = OperatorSet(
+                ops_by_arity={d: tuple(o) for d, o in by_arity.items()})
+        return ComposableExpression(dtree, operators, self.nfeatures)
+
     def _compose(self, args: Sequence["ComposableExpression"]):
         if len(args) < self.nfeatures:
             raise ValueError(

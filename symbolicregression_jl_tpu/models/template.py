@@ -71,11 +71,17 @@ class TemplateReturnError(TypeError):
 
 class ArgumentRecorder:
     """Stand-in subexpression that records call arity during inference
-    (reference TemplateExpression.jl:243-258)."""
+    (reference TemplateExpression.jl:243-258). Derivative call sites
+    (``D(f, k)``) mark the shared record under the reserved ``__D__``
+    key so the structure knows to route constant optimization through
+    the jvp-composable interpreter path."""
 
     def __init__(self, key: str, record: Dict[str, int]):
         self._key = key
         self._record = record
+
+    def _mark_deriv(self, argnum: int) -> None:
+        self._record["__D__"] = 1
 
     def __call__(self, *args):
         prev = self._record.get(self._key, -1)
@@ -110,6 +116,7 @@ class TemplateStructure(NamedTuple):
     param_keys: Tuple[str, ...] = ()
     num_params: Tuple[int, ...] = ()    # per param_key vector length
     n_variables: int = 0                # dataset features consumed
+    uses_deriv: bool = False            # combiner contains D(...) call sites
 
     @property
     def has_params(self) -> bool:
@@ -208,11 +215,35 @@ def make_template_structure(
             )
         num_features = {k: record[k] for k in expr_keys}
         n_variables = inferred_nv
+        uses_deriv = record.get("__D__", 0) > 0
     else:
         if n_variables is None:
             raise ValueError(
                 "Pass `n_variables` along with explicit `num_features`"
             )
+        # Probe solely for D(...) call sites; an un-probeable combiner
+        # conservatively takes the autodiff-composable interpreter path
+        # for constant optimization (correct, just slower).
+        rec2: Dict[str, int] = {}
+        try:
+            exprs2 = SimpleNamespace(
+                **{k: ArgumentRecorder(k, rec2) for k in expr_keys}
+            )
+            dp2 = SimpleNamespace(
+                **{k: ParamVec(jnp.ones((n,), jnp.float32))
+                   for k, n in zip(param_keys, nparams)}
+            )
+            xs2 = tuple(
+                ValidVector(jnp.ones((1,), jnp.float32), jnp.bool_(True))
+                for _ in range(int(n_variables))
+            )
+            if param_keys:
+                combine(exprs2, dp2, xs2)
+            else:
+                combine(exprs2, xs2)
+            uses_deriv = rec2.get("__D__", 0) > 0
+        except Exception:
+            uses_deriv = True
 
     return TemplateStructure(
         combine=combine,
@@ -221,6 +252,7 @@ def make_template_structure(
         param_keys=param_keys,
         num_params=nparams,
         n_variables=int(n_variables),
+        uses_deriv=bool(uses_deriv),
     )
 
 
@@ -341,13 +373,13 @@ class _BatchedTreeCallable:
         self.fused = fused
         self.interpret = interpret
 
-    def __call__(self, *args):
+    def _prep_args(self, args):
+        """(rows, shared, valid_in) from combiner-supplied arguments."""
         if len(args) != self.arity_expected:
             raise ValueError(
                 f"Subexpression {self.key!r} takes {self.arity_expected} "
                 f"arguments; got {len(args)}"
             )
-        n = self.n
         dtype = self.trees.const.dtype
         valid_in = jnp.bool_(True)
         rows = []
@@ -361,6 +393,71 @@ class _BatchedTreeCallable:
             if x.ndim >= 2:
                 shared = False
             rows.append(x)
+        return rows, shared, valid_in
+
+    def _member_x(self, rows):
+        """Broadcast arguments to a per-member [M, a, n] input block."""
+        M = self.trees.arity.shape[0]
+        n = self.n
+        dtype = self.trees.const.dtype
+        if not rows:
+            return jnp.zeros((M, 1, n), dtype)
+        return jnp.stack(
+            [jnp.broadcast_to(jnp.atleast_1d(r), (M, n)) for r in rows],
+            axis=1,
+        ).astype(dtype)
+
+    def derivative(self, argnum: int, *args):
+        """Row-wise ∂ self(args) / ∂ args[argnum-1] — the ``D`` operator.
+
+        Rows are independent, so on the fused path the derivative is a
+        VJP with an all-ones cotangent: `fused_predict_ad`'s backward
+        emits per-argument row cotangents (gx) in per-member X mode.
+        The interpreter path uses forward-mode (jax.jvp), which also
+        composes under jax.grad for constant optimization — structures
+        with D call sites set `uses_deriv` and optimize on that path.
+        """
+        if not 1 <= argnum <= self.arity_expected:
+            raise ValueError(
+                f"D argnum {argnum} out of range 1..{self.arity_expected} "
+                f"for subexpression {self.key!r}"
+            )
+        rows, _, valid_in = self._prep_args(args)
+        Xm = self._member_x(rows)
+        tr = self.trees
+        if self.fused:
+            from ..ops.fused_eval import fused_predict_ad
+
+            (pred, v), vjp = jax.vjp(
+                lambda xm: fused_predict_ad(
+                    tr, xm, self.operators, interpret=self.interpret),
+                Xm,
+            )
+            ct_valid = np.zeros(v.shape, jax.dtypes.float0)
+            (gx,) = vjp((jnp.ones_like(pred), ct_valid))
+            deriv = gx[:, argnum - 1, :]
+        else:
+            tangent = jnp.zeros_like(Xm).at[:, argnum - 1, :].set(1.0)
+
+            def f(xm):
+                return jax.vmap(
+                    lambda a_, o_, f_, c_, l_, ch_, x_: eval_single_tree(
+                        a_, o_, f_, c_, l_, ch_, x_, self.operators
+                    )
+                )(tr.arity, tr.op, tr.feat, tr.const, tr.length,
+                  self.child, xm)
+
+            (pred, v), (deriv, _) = jax.jvp(f, (Xm,), (tangent,))
+        # Non-finite derivative rows invalidate the member (both paths
+        # surface them as NaN/Inf in the raw derivative).
+        v = v & jnp.all(jnp.isfinite(deriv), axis=-1)
+        deriv = jnp.where(jnp.isfinite(deriv), deriv, 0.0)
+        return ValidVector(deriv, v & valid_in)
+
+    def __call__(self, *args):
+        n = self.n
+        dtype = self.trees.const.dtype
+        rows, shared, valid_in = self._prep_args(args)
 
         tr = self.trees
         if shared:
@@ -386,19 +483,73 @@ class _BatchedTreeCallable:
                     )
                 )(tr.arity, tr.op, tr.feat, tr.const, tr.length, self.child)
         else:
-            M = tr.arity.shape[0]
             # Every argument broadcasts to [M, n]: shared rows [n],
             # per-member rows [M, n], parameter columns [M, 1], scalars.
-            Xm = jnp.stack(
-                [jnp.broadcast_to(jnp.atleast_1d(r), (M, n)) for r in rows],
-                axis=1,
-            )  # [M, a, n]
-            pred, v = jax.vmap(
-                lambda a_, o_, f_, c_, l_, ch_, xm: eval_single_tree(
-                    a_, o_, f_, c_, l_, ch_, xm, self.operators
+            Xm = self._member_x(rows)
+            if self.fused:
+                # Per-member X tiles keep composition chains like g(f(x))
+                # on the fused kernel; its VJP returns d/dX row cotangents
+                # so gradients flow back into the inner call's constants.
+                from ..ops.fused_eval import fused_predict_ad
+
+                pred, v = fused_predict_ad(
+                    tr, Xm, self.operators, interpret=self.interpret,
                 )
-            )(tr.arity, tr.op, tr.feat, tr.const, tr.length, self.child, Xm)
+            else:
+                pred, v = jax.vmap(
+                    lambda a_, o_, f_, c_, l_, ch_, xm: eval_single_tree(
+                        a_, o_, f_, c_, l_, ch_, xm, self.operators
+                    )
+                )(tr.arity, tr.op, tr.feat, tr.const, tr.length, self.child,
+                  Xm)
         return ValidVector(pred, v & valid_in)
+
+
+class _DerivCallable:
+    """Result of ``D(f, argnum)``: a callable evaluating the row-wise
+    partial derivative of subexpression ``f`` w.r.t. its argnum-th
+    argument (1-based, matching the reference's DynamicDiff.D export,
+    /root/reference/src/SymbolicRegression.jl:172)."""
+
+    def __init__(self, f, argnum: int):
+        if not isinstance(argnum, int) or argnum < 1:
+            raise ValueError("D argnum must be a positive integer (1-based)")
+        self.f = f
+        self.argnum = argnum
+
+    def __call__(self, *args):
+        f = self.f
+        if isinstance(f, ArgumentRecorder):
+            f._mark_deriv(self.argnum)
+            return f(*args)
+        if isinstance(f, _BatchedTreeCallable):
+            return f.derivative(self.argnum, *args)
+        if isinstance(f, _DerivCallable):  # higher-order: D(D(f, i), j)
+            raise NotImplementedError(
+                "Nested D is not supported on the device evaluator; "
+                "compose host-side via symbolic differentiation "
+                "(ops.diff.D) instead."
+            )
+        deriv = getattr(f, "derivative", None)
+        if deriv is not None:  # host ComposableExpression
+            return deriv(self.argnum)(*args)
+        raise TypeError(
+            f"D does not know how to differentiate {type(f).__name__}"
+        )
+
+
+def D(f, argnum: int = 1) -> _DerivCallable:
+    """Derivative operator for template combiners.
+
+    ``D(V, 1)(x)`` inside a ``combine`` evaluates dV/darg1 row-wise —
+    the reference's physics-template idiom (e.g. force = -D(potential,
+    1)(r)). Works on device subexpression callables (fused VJP kernel or
+    jvp-composable interpreter; see `_BatchedTreeCallable.derivative`)
+    and on host :class:`ComposableExpression`s (symbolic, via ops.diff.D).
+    Structures with D call sites run constant optimization on the
+    interpreter path (`TemplateStructure.uses_deriv`).
+    """
+    return _DerivCallable(f, argnum)
 
 
 class _BatchedParamVec:

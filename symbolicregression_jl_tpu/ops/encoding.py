@@ -45,7 +45,25 @@ __all__ = [
     "decode_tree",
     "encode_population",
     "tree_structure_arrays",
+    "lane_take",
 ]
+
+
+def lane_take(vals: jax.Array, idx: jax.Array) -> jax.Array:
+    """``take_along_axis(vals, idx, axis=-1)`` via one-hot contraction.
+
+    XLA lowers per-lane dynamic gathers on TPU to a serialized custom
+    fusion (~70M elements/s measured on v5e — it dominated the mutation
+    machinery's cycle cost); for the small minor axes used here (tree
+    slot axes, L <= ~64) a compare + masked-sum is bandwidth-bound
+    instead, ~50x faster. Out-of-range indices yield 0 (callers clip).
+
+    ``vals`` [..., S], ``idx`` [..., K] (leading dims broadcastable) ->
+    [..., K] with vals' dtype.
+    """
+    S = vals.shape[-1]
+    oh = idx[..., :, None] == jnp.arange(S, dtype=idx.dtype)   # [..., K, S]
+    return jnp.sum(jnp.where(oh, vals[..., None, :], 0), axis=-1)
 
 LEAF_CONST = 0
 LEAF_VAR = 1

@@ -1,25 +1,28 @@
-"""Fused Pallas TPU kernel: postfix tree eval + loss reduction per tree.
+"""Fused Pallas TPU kernels over compiled tree programs.
 
-This is the framework's hot op (the "turbo" layer — the role
-LoopVectorization plays in the reference,
-/root/reference/src/InterfaceDynamicExpressions.jl:71-81). The jnp
-interpreter in ops/eval.py materializes a [T, L, n] value buffer in HBM
-and computes *every* operator at every slot; this kernel instead:
+This is the framework's hot-op layer (the role LoopVectorization plays
+in the reference, /root/reference/src/InterfaceDynamicExpressions.jl:71-81).
+The jnp interpreter in ops/eval.py materializes a [T, L, n] value buffer
+in HBM and computes *every* operator at every slot; these kernels run
+leaf-free TreePrograms (ops/program.py) over a unified VMEM value
+buffer instead — one merged-opcode dispatch per internal node, fused
+loss/gradient reductions, and HBM traffic limited to the X/y row tiles
+plus per-tree scalars.
 
-- keeps a per-tree evaluation **stack** in VMEM (postfix order means each
-  node's operands are the top of the stack — no child-index gathers);
-- dispatches exactly one operator per node via `lax.switch` on the SMEM
-  op code;
-- fuses the elementwise-loss + row reduction, so HBM traffic is just the
-  X/y row tiles (shared across all trees) and one scalar pair per tree.
-
-Outputs per tree: (loss_sum, valid) accumulated over row tiles; the
-wrapper converts to mean loss with the reference's invalid ⇒ Inf
-semantics (/root/reference/src/LossFunctions.jl:96-99).
-
-Stack destinations are data, not control: dst[k] = (exclusive-cumsum of
-(1 - arity))[k] - arity[k] is precomputed with jnp before the kernel, so
-the kernel's only dynamic indexing is the stack-slot store/load.
+Kernel families (all sharing the program interpreter):
+- `fused_loss` / `fused_loss_program`: mean elementwise loss per tree
+  with the reference's invalid ⇒ Inf semantics
+  (/root/reference/src/LossFunctions.jl:96-99).
+- `fused_loss_multi` / `fused_grad_multi`: a variants axis evaluates V
+  constant vectors per compiled tree in ONE instruction-stream dispatch
+  — the BFGS line search and restart gradients ride it.
+- `fused_grad_program` / `fused_loss_and_const_grad`: forward+backward
+  in one kernel, gradients w.r.t. constant leaves (the reference's
+  Enzyme/Mooncake role, /root/reference/src/ConstantOptimization.jl:136-167).
+- `fused_predict` / `fused_predict_ad`: raw row predictions for
+  template call sites, with a custom VJP whose per-member mode also
+  emits argument cotangents (composition chains, the template D
+  operator).
 """
 
 from __future__ import annotations
@@ -33,20 +36,15 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .encoding import LEAF_CONST, LEAF_VAR, TreeBatch, tree_structure_arrays
+from .encoding import TreeBatch
 from .operators import OperatorSet
 from .program import TreeProgram, compile_program
 
-__all__ = ["fused_loss", "fused_loss_program", "fused_loss_and_const_grad",
-           "fused_predict", "fused_predict_ad", "stack_positions",
+__all__ = ["fused_loss", "fused_loss_program", "fused_loss_multi",
+           "fused_grad_program", "fused_grad_multi",
+           "fused_loss_and_const_grad", "fused_predict",
+           "fused_predict_program", "fused_predict_ad",
            "supports_fused_eval"]
-
-
-def stack_positions(arity: jax.Array) -> jax.Array:
-    """dst[k]: stack slot written by postfix slot k (see module doc)."""
-    one_minus_a = 1 - arity
-    excl = jnp.cumsum(one_minus_a, axis=-1) - one_minus_a
-    return excl - arity
 
 
 def _round_up(x: int, m: int) -> int:
@@ -71,64 +69,6 @@ def _pick_tile(n: int, tile_cap: int, vmem_rows: int, bytes_per: int,
 def supports_fused_eval(operators: OperatorSet) -> bool:
     """The kernel handles arity <= 2 operator sets (current encoding)."""
     return all(d in (1, 2) for d in operators.ops.keys())
-
-
-def _tree_kernel_body(
-    t: int,
-    k,
-    arity_ref,
-    op_ref,
-    feat_ref,
-    dst_ref,
-    const_ref,
-    x_ref,
-    stack_ref,
-    vmask,
-    unary_fns,
-    binary_fns,
-):
-    """Evaluate slot k of tree t (one step of the fori_loop).
-
-    No in-tree guard: padding slots are arity-0 const-0 leaves whose
-    (clamped) stack writes land above the live region — slot 0, where the
-    root value ends up, is never touched by them (the running stack
-    pointer after the root is >= 1). Validity is accumulated as a per-row
-    vector mask (one cross-lane reduction at the end instead of one per
-    slot); a row is valid iff every node output at that row is finite —
-    equivalent to the reference's per-node buffer check
-    (/root/reference/src/LossFunctions.jl:96-99 semantics).
-    """
-    a = arity_ref[t, k]
-    o = op_ref[t, k]
-    d = dst_ref[t, k]
-    tile = stack_ref.shape[-1]
-
-    def leaf_val():
-        x_row = x_ref[feat_ref[t, k], :]
-        c = jnp.full((tile,), const_ref[t, k], dtype=x_ref.dtype)
-        return jnp.where(o == LEAF_CONST, c, x_row)
-
-    def unary_val():
-        child = stack_ref[t, d, :]
-        if len(unary_fns) == 1:
-            return unary_fns[0](child)
-        return jax.lax.switch(o, unary_fns, child)
-
-    def binary_val():
-        l = stack_ref[t, d, :]
-        r = stack_ref[t, d + 1, :]
-        if len(binary_fns) == 1:
-            return binary_fns[0](l, r)
-        return jax.lax.switch(o, binary_fns, l, r)
-
-    branches = [leaf_val]
-    branches.append(unary_val if unary_fns else leaf_val)
-    branches.append(binary_val if binary_fns else leaf_val)
-    val = jax.lax.switch(a, branches)
-
-    stack_ref[t, d, :] = val
-    # float accumulator: Mosaic miscompiles bool vectors as loop carries
-    return vmask * jnp.isfinite(val).astype(vmask.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -483,10 +423,25 @@ def fused_loss_multi(
 
     One instruction-stream dispatch per tree serves all V variants;
     invalid pairs (non-finite eval or non-finite constants) get inf.
+
+    Large V is processed in chunks of 8: VMEM caps (buffer rows × V ×
+    row-tile) force tiny row tiles at big V, and small tiles multiply
+    the per-step dispatch count — 8 variants × ~5k-row tiles is the
+    sweet spot on v5e (measured).
     """
+    V = cvals_v.shape[1]
+    if V > 8:
+        outs = [
+            fused_loss_multi(
+                prog, cvals_v[:, v0:v0 + 8], X, y, weights, nfeatures,
+                operators, loss_fn, tree_block=tree_block,
+                interpret=interpret)
+            for v0 in range(0, V, 8)
+        ]
+        return (jnp.concatenate([o[0] for o in outs], axis=1),
+                jnp.concatenate([o[1] for o in outs], axis=1))
     T, L = prog.code.shape
     CMAX = prog.cmax
-    V = cvals_v.shape[1]
     F, n = X.shape
     dtype = X.dtype
     BASE = nfeatures + CMAX
@@ -756,7 +711,23 @@ def fused_grad_multi(
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(loss [T, V], valid [T, V], dloss/dcvals [T, V, CMAX]) per
-    (tree, constant-variant) pair — one instruction dispatch per tree."""
+    (tree, constant-variant) pair — one instruction dispatch per tree.
+
+    V is chunked like `fused_loss_multi` (the grad kernel holds TWO
+    (BASE+L) x V x TILE scratch buffers, so it hits the VMEM ceiling at
+    half the variant count)."""
+    V = cvals_v.shape[1]
+    if V > 4:
+        outs = [
+            fused_grad_multi(
+                prog, cvals_v[:, v0:v0 + 4], X, y, weights, nfeatures,
+                operators, loss_fn, tree_block=tree_block,
+                interpret=interpret)
+            for v0 in range(0, V, 4)
+        ]
+        return (jnp.concatenate([o[0] for o in outs], axis=1),
+                jnp.concatenate([o[1] for o in outs], axis=1),
+                jnp.concatenate([o[2] for o in outs], axis=1))
     T, L = prog.code.shape
     CMAX = prog.cmax
     V = cvals_v.shape[1]
@@ -907,53 +878,80 @@ def fused_loss(
 
 
 # ---------------------------------------------------------------------------
-# Fused predictions: per-tree row outputs (no loss reduction)
+# Program predict kernels: per-tree row outputs (no loss reduction)
 # ---------------------------------------------------------------------------
 #
 # Used by template expressions: each subexpression call site evaluates a
-# whole member-batch of subtrees over a shared argument matrix and needs
-# the raw predictions back for the combiner's ValidVector algebra
-# (models/template.py). Same VMEM-stack interpreter as `fused_loss`, but
-# the root rows stream out instead of folding into a loss scalar.
+# whole member-batch of subtrees and needs the raw predictions back for
+# the combiner's ValidVector algebra (models/template.py). Two X modes
+# share one kernel factory:
+#   shared     — X [F, n]: dataset columns, identical for every member;
+#   per-member — X [T, F, n]: arguments that are themselves member
+#                outputs (composition chains like g(f(x))), loaded per
+#                tree. The VJP in this mode also emits d/dX row
+#                cotangents so gradients flow back through the chain.
 
 
-def _make_predict_kernel(operators: OperatorSet, max_nodes: int,
-                         tree_block: int):
-    unary_fns = tuple(op.fn for op in operators.unary)
-    binary_fns = tuple(op.fn for op in operators.binary)
+def _make_program_predict_kernel(
+    operators: OperatorSet,
+    tree_block: int,
+    nfeat: int,
+    cmax: int,
+    per_member: bool,
+):
+    BASE = nfeat + cmax
 
     def kernel(
-        arity_ref,   # SMEM [TB, L]
-        op_ref,      # SMEM [TB, L]
-        feat_ref,    # SMEM [TB, L]
-        dst_ref,     # SMEM [TB, L]
-        length_ref,  # SMEM [TB, 1]
-        const_ref,   # SMEM [TB, L] f32
-        x_ref,       # VMEM [F, TILE]
-        mask_ref,    # VMEM [1, TILE] f32: 1.0 real rows
+        instr_ref,   # SMEM [TB, L]
+        nstep_ref,   # SMEM [TB, 1]
+        nconst_ref,  # SMEM [TB, 1]
+        cvals_ref,   # SMEM [TB, CMAX] f32
+        ok_ref,      # SMEM [TB, 1] int32
+        x_ref,       # VMEM [F, TILE] or [TB, F, TILE]
+        mask_ref,    # VMEM [1, TILE]
         pred_ref,    # VMEM out [TB, TILE]
         valid_ref,   # SMEM out [TB, 1] int32
-        stack_ref,   # VMEM scratch [TB, S, TILE]
+        buf_ref,     # VMEM scratch [BASE + L, TILE]
     ):
         j = pl.program_id(1)
         mask_row = mask_ref[0, :] > 0
-        tile = mask_row.shape[0]
+        tile = mask_ref.shape[-1]
+        dtype = buf_ref.dtype
+        L = instr_ref.shape[-1]
+
+        if not per_member:
+            buf_ref[0:nfeat, :] = x_ref[...]
 
         for t in range(tree_block):
-            def body(k, vmask):
-                return _tree_kernel_body(
-                    t, k, arity_ref, op_ref, feat_ref, dst_ref, const_ref,
-                    x_ref, stack_ref, vmask,
-                    unary_fns, binary_fns,
-                )
+            if per_member:
+                buf_ref[0:nfeat, :] = x_ref[t]
+
+            def cbody(c, _):
+                buf_ref[nfeat + c, :] = jnp.full(
+                    (tile,), cvals_ref[t, c], dtype=dtype)
+                return 0
+
+            jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
+
+            def step(k, vmask):
+                o, i1, i2 = _unpack(instr_ref[t, k])
+                val = jax.lax.switch(
+                    o, _merged_branches(
+                        operators, lambda i: buf_ref[i, :], i1, i2))
+                buf_ref[BASE + k, :] = val
+                return vmask * jnp.isfinite(val).astype(vmask.dtype)
+
+            m = nstep_ref[t, 0]
+
+            def pair(k2, vmask):
+                vmask = step(2 * k2, vmask)
+                return step(jnp.minimum(2 * k2 + 1, L - 1), vmask)
 
             vmask = jax.lax.fori_loop(
-                0, length_ref[t, 0], body,
-                jnp.ones((tile,), x_ref.dtype),
-            )
+                0, (m + 1) >> 1, pair, jnp.ones((tile,), dtype))
             valid = jnp.all((vmask > 0) | jnp.logical_not(mask_row))
-            pred_ref[t, :] = stack_ref[t, 0, :]
-            partial_ok = jnp.int32(valid)
+            pred_ref[t, :] = buf_ref[BASE + m - 1, :]
+            partial_ok = jnp.int32(valid) * ok_ref[t, 0]
 
             @pl.when(j == 0)
             def _():
@@ -968,8 +966,89 @@ def _make_predict_kernel(operators: OperatorSet, max_nodes: int,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("operators", "tree_block", "tile_rows", "interpret"),
+    static_argnames=("nfeatures", "operators", "tree_block", "interpret"),
 )
+def fused_predict_program(
+    prog: TreeProgram,          # flat [T, L]
+    X: jax.Array,               # [F, n] shared or [T, F, n] per-member
+    nfeatures: int,
+    operators: OperatorSet,
+    *,
+    tree_block: int = 8,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-tree row predictions (pred [T, n], valid [T]) for compiled
+    programs; X may be shared dataset columns or per-member argument
+    rows."""
+    T, L = prog.code.shape
+    CMAX = prog.cmax
+    per_member = X.ndim == 3
+    F = X.shape[-2]
+    n = X.shape[-1]
+    dtype = X.dtype
+    BASE = nfeatures + CMAX
+    _check_packable(operators, BASE, L)
+
+    TB = tree_block
+    bytes_per = jnp.dtype(dtype).itemsize
+    TILE = _pick_tile(n, 16384, BASE + L, bytes_per)
+    T_pad = _round_up(T, TB)
+    n_pad = _round_up(n, TILE)
+
+    def pad_t(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
+                       constant_values=fill)
+
+    instr = pad_t(_pack_instr(prog))
+    nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
+    nconst = pad_t(prog.nconst.reshape(-1, 1))
+    cvals = pad_t(prog.cvals).astype(dtype)
+    ok = pad_t(prog.const_ok.astype(jnp.int32).reshape(-1, 1), fill=1)
+
+    if per_member:
+        Xp = jnp.pad(X, ((0, T_pad - T), (0, 0), (0, n_pad - n)))
+        x_spec = pl.BlockSpec((TB, F, TILE), lambda i, j: (i, 0, j))
+    else:
+        Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+        x_spec = pl.BlockSpec((F, TILE), lambda i, j: (0, j))
+    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
+
+    grid = (T_pad // TB, n_pad // TILE)
+    kernel = _make_program_predict_kernel(operators, TB, F, CMAX, per_member)
+
+    smem_i32 = lambda shape: pl.BlockSpec(
+        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
+    )
+
+    pred, valid = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            smem_i32((TB, L)),
+            smem_i32((TB, 1)),
+            smem_i32((TB, 1)),
+            pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            smem_i32((TB, 1)),
+            x_spec,
+            pl.BlockSpec((1, TILE), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, TILE), lambda i, j: (i, j)),
+            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_pad, n_pad), dtype),
+            jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((BASE + L, TILE), dtype)],
+        interpret=interpret,
+    )(instr, nsteps, nconst, cvals, ok, Xp, maskp)
+
+    return pred[:T, :n], valid[:T, 0].astype(jnp.bool_)
+
+
 def fused_predict(
     trees: TreeBatch,
     X: jax.Array,               # [F, n]
@@ -983,313 +1062,290 @@ def fused_predict(
 
     Returns ``(pred[..., n], valid[...])`` with the TreeBatch's batch
     dims; validity matches the interpreter (any non-finite node output
-    over the rows invalidates the tree).
+    over the rows invalidates the tree, and non-finite constants
+    invalidate it outright).
     """
+    del tile_rows
     batch_shape = trees.batch_shape
     flat = trees.reshape(-1) if batch_shape else trees.reshape(1)
-    T = flat.length.shape[0]
-    L = flat.arity.shape[-1]
     F, n = X.shape
-    dtype = X.dtype
-
-    TB = tree_block
-    S = L // 2 + 2
-    bytes_per = jnp.dtype(dtype).itemsize
-    TILE = _pick_tile(n, tile_rows, TB * S, bytes_per)
-    T_pad = _round_up(T, TB)
-    n_pad = _round_up(n, TILE)
-
-    def pad_trees(x, fill=0):
-        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
-                       constant_values=fill)
-
-    arity = pad_trees(flat.arity)
-    op = pad_trees(flat.op)
-    feat = jnp.clip(pad_trees(flat.feat), 0, F - 1)
-    const = pad_trees(flat.const).astype(dtype)
-    length = jnp.clip(pad_trees(flat.length.reshape(-1, 1), fill=1), 1, L)
-    dst = jnp.clip(stack_positions(arity), 0, S - 1)
-
-    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
-    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
-
-    grid = (T_pad // TB, n_pad // TILE)
-    kernel = _make_predict_kernel(operators, L, TB)
-
-    smem_i32 = lambda shape: pl.BlockSpec(
-        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
-    )
-
-    pred, valid = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            smem_i32((TB, L)),                       # arity
-            smem_i32((TB, L)),                       # op
-            smem_i32((TB, L)),                       # feat
-            smem_i32((TB, L)),                       # dst
-            smem_i32((TB, 1)),                       # length
-            pl.BlockSpec((TB, L), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),   # const
-            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),  # X
-            pl.BlockSpec((1, TILE), lambda i, j: (0, j)),  # mask
-        ],
-        out_specs=[
-            pl.BlockSpec((TB, TILE), lambda i, j: (i, j)),
-            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T_pad, n_pad), dtype),
-            jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
-        ],
-        scratch_shapes=[pltpu.VMEM((TB, S, TILE), dtype)],
-        interpret=interpret,
-    )(arity, op, feat, dst, length, const, Xp, maskp)
-
-    pred = pred[:T, :n]
-    valid = valid[:T, 0].astype(jnp.bool_)
+    prog = compile_program(flat, F, len(operators.binary))
+    pred, valid = fused_predict_program(
+        prog, X, F, operators, tree_block=tree_block, interpret=interpret)
     if batch_shape:
         return pred.reshape(*batch_shape, n), valid.reshape(batch_shape)
     return pred[0], valid[0]
 
 
 # ---------------------------------------------------------------------------
-# fused_predict VJP: cotangent-seeded constant gradients
+# Predict VJP: cotangent-seeded constant (and argument) gradients
 # ---------------------------------------------------------------------------
 #
 # Differentiable prediction powers template constant optimization: the
 # combiner's elementwise algebra is differentiated by JAX as usual, and
 # each fused call site's backward contracts the incoming row cotangent
-# with the subtree's adjoint sweep in one kernel — no [M, L, n]
-# interpreter buffers. ``X`` is treated as constant data (zero
-# cotangent): fused call sites only ever receive dataset columns (the
-# batched template evaluator routes member-dependent arguments through
-# the jnp interpreter, which differentiates natively).
+# with the subtree's adjoint sweep in one kernel. In per-member mode the
+# X-region adjoint rows ARE the argument cotangents (composition chains
+# need them); a subtree may reference the same argument at several
+# leaves, so X-region adjoints accumulate (+=) over a zeroed region
+# while the write-once tree regions stay plain stores.
 
 
-def _make_predict_vjp_kernel(operators: OperatorSet, max_nodes: int,
-                             tree_block: int):
+def _make_program_predict_vjp_kernel(
+    operators: OperatorSet,
+    tree_block: int,
+    nfeat: int,
+    cmax: int,
+    per_member: bool,
+):
     unary_fns = tuple(op.fn for op in operators.unary)
     binary_fns = tuple(op.fn for op in operators.binary)
-    L = max_nodes
+    B = len(binary_fns)
+    BASE = nfeat + cmax
 
     def kernel(
-        arity_ref,   # SMEM [TB, L]
-        op_ref,      # SMEM [TB, L]
-        feat_ref,    # SMEM [TB, L]
-        child1_ref,  # SMEM [TB, L]
-        child2_ref,  # SMEM [TB, L]
-        root_ref,    # SMEM [TB, 1]
-        const_ref,   # SMEM [TB, L] f32
-        cmask_ref,   # VMEM [TB, L] f32
-        x_ref,       # VMEM [F, TILE]
+        instr_ref,   # SMEM [TB, L]
+        nstep_ref,   # SMEM [TB, 1]
+        nconst_ref,  # SMEM [TB, 1]
+        cvals_ref,   # SMEM [TB, CMAX] f32
+        x_ref,       # VMEM [F, TILE] or [TB, F, TILE]
         ct_ref,      # VMEM [TB, TILE] — incoming row cotangents
         mask_ref,    # VMEM [1, TILE]
-        gconst_ref,  # VMEM out [TB, L]
-        buf_ref,     # VMEM scratch [L, TILE]
-        adj_ref,     # VMEM scratch [L, TILE]
+        gcomp_ref,   # SMEM out [TB, CMAX] (scalar stores)
+        gx_ref,      # VMEM out [TB, F, TILE] (dummy [TB, 1, TILE] if shared)
+        buf_ref,     # VMEM scratch [BASE + L, TILE]
+        adj_ref,     # VMEM scratch [BASE + L, TILE]
     ):
         j = pl.program_id(1)
         mask_row = mask_ref[0, :] > 0
         tile = mask_ref.shape[-1]
+        dtype = buf_ref.dtype
+        L = instr_ref.shape[-1]
+        read = lambda i: buf_ref[i, :]
+
+        if not per_member:
+            buf_ref[0:nfeat, :] = x_ref[...]
 
         for t in range(tree_block):
-            root = root_ref[t, 0]
+            if per_member:
+                buf_ref[0:nfeat, :] = x_ref[t]
 
-            def fwd(k, _):
-                a = arity_ref[t, k]
-                o = op_ref[t, k]
-
-                def leaf_val():
-                    x_row = x_ref[feat_ref[t, k], :]
-                    c = jnp.full((tile,), const_ref[t, k], dtype=x_ref.dtype)
-                    return jnp.where(o == LEAF_CONST, c, x_row)
-
-                def unary_val():
-                    child = buf_ref[child1_ref[t, k], :]
-                    if len(unary_fns) == 1:
-                        return unary_fns[0](child)
-                    return jax.lax.switch(o, unary_fns, child)
-
-                def binary_val():
-                    l = buf_ref[child1_ref[t, k], :]
-                    r = buf_ref[child2_ref[t, k], :]
-                    if len(binary_fns) == 1:
-                        return binary_fns[0](l, r)
-                    return jax.lax.switch(o, binary_fns, l, r)
-
-                branches = [leaf_val]
-                branches.append(unary_val if unary_fns else leaf_val)
-                branches.append(binary_val if binary_fns else leaf_val)
-                buf_ref[k, :] = jax.lax.switch(a, branches)
+            def cbody(c, _):
+                buf_ref[nfeat + c, :] = jnp.full(
+                    (tile,), cvals_ref[t, c], dtype=dtype)
                 return 0
 
-            jax.lax.fori_loop(0, root + 1, fwd, 0)
+            jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
 
-            adj_ref[...] = jnp.zeros((L, tile), dtype=x_ref.dtype)
-            adj_ref[root, :] = jnp.where(mask_row, ct_ref[t, :], 0.0)
+            def fwd(k, _):
+                o, i1, i2 = _unpack(instr_ref[t, k])
+                buf_ref[BASE + k, :] = jax.lax.switch(
+                    o, _merged_branches(operators, read, i1, i2))
+                return 0
 
-            def bwd(i, _):
-                k = root - i
-                a = arity_ref[t, k]
-                o = op_ref[t, k]
-                c1 = child1_ref[t, k]
-                c2 = child2_ref[t, k]
-                ct = adj_ref[k, :]
-                x1 = buf_ref[c1, :]
-                x2 = buf_ref[c2, :]
+            m = nstep_ref[t, 0]
 
-                if unary_fns:
-                    @pl.when(a == 1)
-                    def _():
-                        if len(unary_fns) == 1:
-                            du = _vjp_unary(unary_fns[0], x1, ct)
-                        else:
-                            du = jax.lax.switch(
-                                o, [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
-                                    for f in unary_fns], x1, ct)
-                        du = jnp.where(mask_row, du, 0.0)
-                        adj_ref[c1, :] = adj_ref[c1, :] + du
+            def fwd_pair(k2, _):
+                fwd(2 * k2, 0)
+                return fwd(jnp.minimum(2 * k2 + 1, L - 1), 0)
+
+            jax.lax.fori_loop(0, (m + 1) >> 1, fwd_pair, 0)
+
+            # X-region adjoints accumulate (same argument can appear at
+            # several leaves); tree regions are written exactly once.
+            adj_ref[0:nfeat, :] = jnp.zeros((nfeat, tile), dtype)
+            adj_ref[BASE + m - 1, :] = jnp.where(mask_row, ct_ref[t, :], 0.0)
+
+            def store_adj(iaddr, val):
+                @pl.when(iaddr < nfeat)
+                def _():
+                    adj_ref[iaddr, :] = adj_ref[iaddr, :] + val
+
+                @pl.when(iaddr >= nfeat)
+                def _():
+                    adj_ref[iaddr, :] = val
+
+            def bwd(k):
+                o, i1, i2 = _unpack(instr_ref[t, k])
+                ct = adj_ref[BASE + k, :]
+
+                @pl.when(o == 0)
+                def _():
+                    store_adj(i1, ct)
 
                 if binary_fns:
-                    @pl.when(a == 2)
+                    @pl.when((o >= 1) & (o <= B))
                     def _():
+                        x1 = read(i1)
+                        x2 = read(i2)
                         if len(binary_fns) == 1:
                             db1, db2 = _vjp_binary(binary_fns[0], x1, x2, ct)
                         else:
                             db1, db2 = jax.lax.switch(
-                                o, [lambda xx, yy, cc, f=f:
-                                    _vjp_binary(f, xx, yy, cc)
-                                    for f in binary_fns], x1, x2, ct)
-                        db1 = jnp.where(mask_row, db1, 0.0)
-                        db2 = jnp.where(mask_row, db2, 0.0)
-                        adj_ref[c1, :] = adj_ref[c1, :] + db1
-                        adj_ref[c2, :] = adj_ref[c2, :] + db2
+                                o - 1,
+                                [lambda xx, yy, cc, f=f:
+                                 _vjp_binary(f, xx, yy, cc)
+                                 for f in binary_fns], x1, x2, ct)
+                        store_adj(i1, jnp.where(mask_row, db1, 0.0))
+                        store_adj(i2, jnp.where(mask_row, db2, 0.0))
+
+                if unary_fns:
+                    @pl.when(o > B)
+                    def _():
+                        x1 = read(i1)
+                        if len(unary_fns) == 1:
+                            du = _vjp_unary(unary_fns[0], x1, ct)
+                        else:
+                            du = jax.lax.switch(
+                                o - 1 - B,
+                                [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
+                                 for f in unary_fns], x1, ct)
+                        store_adj(i1, jnp.where(mask_row, du, 0.0))
+
+            def bwd_pair(i2x, _):
+                # X-region adjoints ACCUMULATE, so the odd tail must be
+                # guarded, not clamped — re-executing step 0 would
+                # double-count its argument contributions.
+                bwd(m - 1 - 2 * i2x)
+                k2 = m - 2 - 2 * i2x
+
+                @pl.when(k2 >= 0)
+                def _():
+                    bwd(k2)
+
                 return 0
 
-            jax.lax.fori_loop(0, root + 1, bwd, 0)
-            grow = jnp.sum(adj_ref[...], axis=1) * cmask_ref[t, :]
+            jax.lax.fori_loop(0, (m + 1) >> 1, bwd_pair, 0)
 
             @pl.when(j == 0)
             def _():
-                gconst_ref[t, :] = grow
+                for c in range(cmax):  # SMEM: scalar stores only
+                    gcomp_ref[t, c] = 0.0
 
-            @pl.when(j != 0)
-            def _():
-                gconst_ref[t, :] = gconst_ref[t, :] + grow
+            def gbody(c, _):
+                gcomp_ref[t, c] = gcomp_ref[t, c] + jnp.sum(
+                    adj_ref[nfeat + c, :])
+                return 0
+
+            jax.lax.fori_loop(0, nconst_ref[t, 0], gbody, 0)
+
+            if per_member:
+                gx_ref[t] = adj_ref[0:nfeat, :]
 
     return kernel
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("operators", "tree_block", "tile_rows", "interpret"),
+    static_argnames=("nfeatures", "operators", "tree_block", "interpret"),
 )
-def _fused_predict_vjp(
-    trees: TreeBatch,           # [T, L] flat
-    X: jax.Array,               # [F, n]
+def _fused_predict_vjp_program(
+    prog: TreeProgram,
+    X: jax.Array,               # [F, n] or [T, F, n]
     ct: jax.Array,              # [T, n] row cotangents
+    nfeatures: int,
     operators: OperatorSet,
     *,
     tree_block: int = 8,
-    tile_rows: int = 16384,
     interpret: bool = False,
-) -> jax.Array:
-    """d(sum(ct * pred)) / d(trees.const) — [T, L], zero off constant
-    leaves, non-finite contributions zeroed."""
-    T, L = trees.arity.shape
-    F, n = X.shape
+):
+    """d(sum(ct * pred)) / d(cvals) [T, CMAX] and, in per-member mode,
+    d/dX [T, F, n]; non-finite contributions zeroed."""
+    T, L = prog.code.shape
+    CMAX = prog.cmax
+    per_member = X.ndim == 3
+    F = X.shape[-2]
+    n = X.shape[-1]
     dtype = X.dtype
-    child, _, _ = tree_structure_arrays(trees, need_depth=False)
+    BASE = nfeatures + CMAX
+    _check_packable(operators, BASE, L)
 
     TB = tree_block
     bytes_per = jnp.dtype(dtype).itemsize
-    TILE = _pick_tile(n, tile_rows, 2 * L + TB, bytes_per)
+    TILE = _pick_tile(n, 16384, 2 * (BASE + L), bytes_per)
     T_pad = _round_up(T, TB)
     n_pad = _round_up(n, TILE)
 
-    def pad_trees(x, fill=0):
+    def pad_t(x, fill=0):
         return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
                        constant_values=fill)
 
-    arity = pad_trees(trees.arity)
-    op = pad_trees(trees.op)
-    feat = jnp.clip(pad_trees(trees.feat), 0, F - 1)
-    const = pad_trees(trees.const).astype(dtype)
-    child1 = jnp.clip(pad_trees(child[..., 0]), 0, L - 1)
-    child2 = jnp.clip(pad_trees(child[..., 1]), 0, L - 1)
-    root = jnp.clip(pad_trees(trees.length.reshape(-1, 1), fill=1) - 1, 0, L - 1)
-    slot = jnp.arange(L)
-    cmask = (
-        (slot[None, :] < trees.length[:, None])
-        & (trees.arity == 0)
-        & (trees.op == LEAF_CONST)
-    ).astype(dtype)
-    cmask = pad_trees(cmask)
+    instr = pad_t(_pack_instr(prog))
+    nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
+    nconst = pad_t(prog.nconst.reshape(-1, 1))
+    cvals = pad_t(prog.cvals).astype(dtype)
 
-    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+    if per_member:
+        Xp = jnp.pad(X, ((0, T_pad - T), (0, 0), (0, n_pad - n)))
+        x_spec = pl.BlockSpec((TB, F, TILE), lambda i, j: (i, 0, j))
+        FG = F
+    else:
+        Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+        x_spec = pl.BlockSpec((F, TILE), lambda i, j: (0, j))
+        FG = 1
     ctp = jnp.pad(ct.astype(dtype), ((0, T_pad - T), (0, n_pad - n)))
     maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
 
     grid = (T_pad // TB, n_pad // TILE)
-    kernel = _make_predict_vjp_kernel(operators, L, TB)
+    kernel = _make_program_predict_vjp_kernel(
+        operators, TB, F, CMAX, per_member)
 
     smem_i32 = lambda shape: pl.BlockSpec(
         shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
     )
 
-    (gconst,) = pl.pallas_call(
+    gcomp, gx = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             smem_i32((TB, L)),
-            smem_i32((TB, L)),
-            smem_i32((TB, L)),
-            smem_i32((TB, L)),
-            smem_i32((TB, L)),
             smem_i32((TB, 1)),
-            pl.BlockSpec((TB, L), lambda i, j: (i, 0),
+            smem_i32((TB, 1)),
+            pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((TB, L), lambda i, j: (i, 0)),       # cmask
-            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),     # X
+            x_spec,
             pl.BlockSpec((TB, TILE), lambda i, j: (i, j)),    # ct
             pl.BlockSpec((1, TILE), lambda i, j: (0, j)),     # mask
         ],
         out_specs=[
-            pl.BlockSpec((TB, L), lambda i, j: (i, 0)),
+            pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((TB, FG, TILE), lambda i, j: (i, 0, j)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((T_pad, L), dtype)],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_pad, CMAX), dtype),
+            jax.ShapeDtypeStruct((T_pad, FG, n_pad), dtype),
+        ],
         scratch_shapes=[
-            pltpu.VMEM((L, TILE), dtype),
-            pltpu.VMEM((L, TILE), dtype),
+            pltpu.VMEM((BASE + L, TILE), dtype),
+            pltpu.VMEM((BASE + L, TILE), dtype),
         ],
         interpret=interpret,
-    )(arity, op, feat, child1, child2, root, const, cmask, Xp, ctp, maskp)
+    )(instr, nsteps, nconst, cvals, Xp, ctp, maskp)
 
-    gconst = gconst[:T]
-    return jnp.where(jnp.isfinite(gconst), gconst, 0.0)
+    gcomp = gcomp[:T]
+    gcomp = jnp.where(jnp.isfinite(gcomp), gcomp, 0.0)
+    if per_member:
+        # gx stays RAW (no non-finite masking): downstream consumers
+        # either mask (the optimizer's masked_grad) or use NaN as a
+        # validity signal (the template D operator) — matching the jnp
+        # interpreter's autodiff semantics.
+        return gcomp, gx[:T, :, :n]
+    return gcomp, None
 
 
 _PREDICT_AD_CACHE: dict = {}
 
 
-def fused_predict_ad(trees: TreeBatch, X: jax.Array, operators: OperatorSet,
-                     *, interpret: bool = False):
-    """`fused_predict` with a custom VJP w.r.t. the constant leaves.
-
-    Gradients flow into ``trees.const`` only; ``X`` and the structural
-    int fields get zero cotangents (fused template call sites receive
-    dataset columns, which are constants of the optimization).
-    Flat [T, L] trees only.
-    """
-    key = (operators, interpret)
+def _predict_ad_impl(operators: OperatorSet, interpret: bool, per_member: bool):
+    key = (operators, interpret, per_member)
     if key not in _PREDICT_AD_CACHE:
         def primal(arity, op, feat, const, length, X):
-            return fused_predict(
-                TreeBatch(arity, op, feat, const, length), X, operators,
-                interpret=interpret,
-            )
+            trees = TreeBatch(arity, op, feat, const, length)
+            F = X.shape[-2]
+            prog = compile_program(trees, F, len(operators.binary))
+            return fused_predict_program(
+                prog, X, F, operators, interpret=interpret)
 
         f = jax.custom_vjp(primal)
 
@@ -1300,33 +1356,37 @@ def fused_predict_ad(trees: TreeBatch, X: jax.Array, operators: OperatorSet,
         def bwd(res, cts):
             arity, op, feat, const, length, X = res
             ct_pred, _ = cts  # valid output is boolean (float0 cotangent)
-            gconst = _fused_predict_vjp(
-                TreeBatch(arity, op, feat, const, length), X, ct_pred,
-                operators, interpret=interpret,
-            )
+            trees = TreeBatch(arity, op, feat, const, length)
+            F = X.shape[-2]
+            L = arity.shape[-1]
+            prog = compile_program(trees, F, len(operators.binary))
+            gcomp, gx = _fused_predict_vjp_program(
+                prog, X, ct_pred, F, operators, interpret=interpret)
+            from .program import scatter_const_grads
+
+            gconst = scatter_const_grads(prog, gcomp, L)
             f0 = lambda x: np.zeros(x.shape, jax.dtypes.float0)
-            return (f0(arity), f0(op), f0(feat), gconst, f0(length),
-                    jnp.zeros_like(X))
+            if gx is None:
+                gx = jnp.zeros_like(X)
+            return (f0(arity), f0(op), f0(feat), gconst, f0(length), gx)
 
         f.defvjp(fwd, bwd)
         _PREDICT_AD_CACHE[key] = f
-    f = _PREDICT_AD_CACHE[key]
+    return _PREDICT_AD_CACHE[key]
+
+
+def fused_predict_ad(trees: TreeBatch, X: jax.Array, operators: OperatorSet,
+                     *, interpret: bool = False):
+    """`fused_predict` with a custom VJP.
+
+    Gradients flow into ``trees.const``; with shared X [F, n] (dataset
+    columns) X gets a zero cotangent, while per-member X [T, F, n]
+    (composition-chain arguments) receives real row cotangents from the
+    adjoint sweep so chains like g(f(x)) differentiate end to end.
+    Flat [T, L] trees only.
+    """
+    f = _predict_ad_impl(operators, interpret, X.ndim == 3)
     return f(trees.arity, trees.op, trees.feat, trees.const, trees.length, X)
-
-
-# ---------------------------------------------------------------------------
-# Fused forward + backward: loss and d(loss)/d(const) in one kernel
-# ---------------------------------------------------------------------------
-#
-# This replaces `jax.grad` through the jnp interpreter for constant
-# optimization (the reference's Enzyme/Mooncake reverse pass,
-# /root/reference/src/ConstantOptimization.jl:136-167). The jnp/AD path
-# materializes [T, L, n] forward buffers in HBM per gradient evaluation —
-# the dominant cost (and OOM source) of the whole search iteration. Here
-# the per-tree value buffer and adjoint live in VMEM, derivative code for
-# each operator is generated at trace time with `jax.vjp` on the op's own
-# fn (so custom traceable operators differentiate automatically), and the
-# only HBM traffic is the X/y row tiles plus a [T, L] gradient output.
 
 
 def _vjp_unary(fn, x, ct):
@@ -1339,167 +1399,6 @@ def _vjp_binary(fn, x, y, ct):
     _, vjp = jax.vjp(fn, x, y)
     dx, dy = vjp(ct)
     return dx, dy
-
-
-def _make_grad_kernel(
-    operators: OperatorSet,
-    loss_fn: Callable,
-    max_nodes: int,
-    tree_block: int,
-):
-    unary_fns = tuple(op.fn for op in operators.unary)
-    binary_fns = tuple(op.fn for op in operators.binary)
-    L = max_nodes
-
-    def kernel(
-        arity_ref,   # SMEM [TB, L]
-        op_ref,      # SMEM [TB, L]
-        feat_ref,    # SMEM [TB, L]
-        child1_ref,  # SMEM [TB, L]
-        child2_ref,  # SMEM [TB, L]
-        root_ref,    # SMEM [TB, 1] (length - 1)
-        const_ref,   # SMEM [TB, L] f32
-        cmask_ref,   # VMEM [TB, L] f32: 1.0 at constant-leaf slots
-        x_ref,       # VMEM [F, TILE]
-        y_ref,       # VMEM [1, TILE]
-        w_ref,       # VMEM [1, TILE]
-        mask_ref,    # VMEM [1, TILE]
-        loss_ref,    # SMEM out [TB, 1] f32 (loss sum over rows)
-        valid_ref,   # SMEM out [TB, 1] int32
-        gconst_ref,  # VMEM out [TB, L] f32 (d loss_sum / d const)
-        buf_ref,     # VMEM scratch [L, TILE] — forward values per slot
-        adj_ref,     # VMEM scratch [L, TILE] — adjoints per slot
-    ):
-        j = pl.program_id(1)
-        y_row = y_ref[0, :]
-        mask_row = mask_ref[0, :] > 0
-        w_row = w_ref[0, :] * mask_ref[0, :]
-        tile = y_row.shape[0]
-
-        for t in range(tree_block):
-            root = root_ref[t, 0]
-
-            # ---- forward: slot-indexed buffer interpreter ----
-            def fwd(k, vmask):
-                a = arity_ref[t, k]
-                o = op_ref[t, k]
-
-                def leaf_val():
-                    x_row = x_ref[feat_ref[t, k], :]
-                    c = jnp.full((tile,), const_ref[t, k], dtype=x_ref.dtype)
-                    return jnp.where(o == LEAF_CONST, c, x_row)
-
-                def unary_val():
-                    child = buf_ref[child1_ref[t, k], :]
-                    if len(unary_fns) == 1:
-                        return unary_fns[0](child)
-                    return jax.lax.switch(o, unary_fns, child)
-
-                def binary_val():
-                    l = buf_ref[child1_ref[t, k], :]
-                    r = buf_ref[child2_ref[t, k], :]
-                    if len(binary_fns) == 1:
-                        return binary_fns[0](l, r)
-                    return jax.lax.switch(o, binary_fns, l, r)
-
-                branches = [leaf_val]
-                branches.append(unary_val if unary_fns else leaf_val)
-                branches.append(binary_val if binary_fns else leaf_val)
-                val = jax.lax.switch(a, branches)
-                buf_ref[k, :] = val
-                return vmask * jnp.isfinite(val).astype(vmask.dtype)
-
-            # Dynamic trip counts (see fused_loss): only the tree's used
-            # slots are interpreted, forward and backward.
-            vmask = jax.lax.fori_loop(
-                0, root + 1, fwd, jnp.ones((tile,), y_row.dtype)
-            )
-            valid = jnp.all((vmask > 0) | jnp.logical_not(mask_row))
-
-            # ---- loss + dloss/dpred ----
-            pred = buf_ref[root, :]
-            elt, loss_vjp = jax.vjp(lambda p: loss_fn(p, y_row), pred)
-            elt = jnp.where(w_row > 0, elt, 0.0)
-            partial = jnp.sum(elt * w_row)
-            partial_ok = jnp.int32(valid & jnp.isfinite(partial))
-            (dpred,) = loss_vjp(w_row)
-            dpred = jnp.where(w_row > 0, dpred, 0.0)
-
-            # ---- backward: adjoint sweep root -> leaves ----
-            # Padding slots (arity 0) clip children to slot 0 and carry
-            # zero cotangents, so their accumulates are no-ops; pure value
-            # switches + masked adds avoid side effects under lax.switch.
-            adj_ref[...] = jnp.zeros((L, tile), dtype=y_row.dtype)
-            adj_ref[root, :] = dpred
-
-            def bwd(i, _):
-                k = root - i
-                a = arity_ref[t, k]
-                o = op_ref[t, k]
-                c1 = child1_ref[t, k]
-                c2 = child2_ref[t, k]
-                ct = adj_ref[k, :]
-                x1 = buf_ref[c1, :]
-                x2 = buf_ref[c2, :]
-
-                # Gate each arity's vjp behind pl.when: a scalar branch
-                # per slot skips the other arity's derivative entirely
-                # (computing both and selecting doubled the backward
-                # cost). Padded rows carry zero cotangents but arbitrary
-                # operand values, so op vjps can produce 0/0 = NaN there;
-                # mask before accumulating or one NaN poisons the sums.
-                if unary_fns:
-                    @pl.when(a == 1)
-                    def _():
-                        if len(unary_fns) == 1:
-                            du = _vjp_unary(unary_fns[0], x1, ct)
-                        else:
-                            du = jax.lax.switch(
-                                o, [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
-                                    for f in unary_fns], x1, ct)
-                        du = jnp.where(mask_row, du, 0.0)
-                        adj_ref[c1, :] = adj_ref[c1, :] + du
-
-                if binary_fns:
-                    @pl.when(a == 2)
-                    def _():
-                        if len(binary_fns) == 1:
-                            db1, db2 = _vjp_binary(binary_fns[0], x1, x2, ct)
-                        else:
-                            db1, db2 = jax.lax.switch(
-                                o, [lambda xx, yy, cc, f=f:
-                                    _vjp_binary(f, xx, yy, cc)
-                                    for f in binary_fns], x1, x2, ct)
-                        db1 = jnp.where(mask_row, db1, 0.0)
-                        db2 = jnp.where(mask_row, db2, 0.0)
-                        adj_ref[c1, :] = adj_ref[c1, :] + db1
-                        adj_ref[c2, :] = adj_ref[c2, :] + db2
-                return 0
-
-            jax.lax.fori_loop(0, root + 1, bwd, 0)
-
-            # ---- per-slot constant gradients (sum over rows) ----
-            grow = jnp.sum(adj_ref[...], axis=1) * cmask_ref[t, :]
-
-            @pl.when(j == 0)
-            def _():
-                gconst_ref[t, :] = grow
-
-            @pl.when(j != 0)
-            def _():
-                gconst_ref[t, :] = gconst_ref[t, :] + grow
-
-            @pl.when(j == 0)
-            def _():
-                loss_ref[t, 0] = partial
-                valid_ref[t, 0] = partial_ok
-
-            @pl.when(j != 0)
-            def _():
-                loss_ref[t, 0] = loss_ref[t, 0] + partial
-                valid_ref[t, 0] = valid_ref[t, 0] & partial_ok
-
-    return kernel
 
 
 @functools.partial(

@@ -52,7 +52,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from .encoding import LEAF_VAR, TreeBatch, _structure_from_arity
+from .encoding import (LEAF_VAR, TreeBatch, _structure_from_arity,
+                       lane_take)
 
 __all__ = ["TreeProgram", "compile_program", "update_consts",
            "const_mask_compressed", "scatter_const_grads", "program_cmax"]
@@ -129,22 +130,22 @@ def compile_program(trees: TreeBatch, nfeatures: int, n_binary: int,
         arity == 2, 1 + op,
         jnp.where(arity == 1, 1 + n_binary + op, 0),
     ).astype(jnp.int32)
-    src1_slot = jnp.take_along_axis(addr, child[..., 0], axis=-1)
+    src1_slot = lane_take(addr, child[..., 0])
     src2_slot = jnp.where(
-        arity == 2, jnp.take_along_axis(addr, child[..., 1], axis=-1),
+        arity == 2, lane_take(addr, child[..., 1]),
         src1_slot,
     )
 
     # Compress: internal slots first, in postfix order (keys are unique).
     order = jnp.argsort(jnp.where(internal, slot[None, :], L + slot[None, :]),
                         axis=-1)
-    code = jnp.take_along_axis(code_slot, order, axis=-1)
-    src1 = jnp.take_along_axis(src1_slot, order, axis=-1)
-    src2 = jnp.take_along_axis(src2_slot, order, axis=-1)
+    code = lane_take(code_slot, order)
+    src1 = lane_take(src1_slot, order)
+    src2 = lane_take(src2_slot, order)
 
     m = jnp.sum(internal, axis=-1)
     root_slot = jnp.clip(length - 1, 0, L - 1)
-    root_addr = jnp.take_along_axis(addr, root_slot[:, None], axis=-1)[:, 0]
+    root_addr = lane_take(addr, root_slot[:, None])[:, 0]
     leaf_only = m == 0
     code = code.at[:, 0].set(jnp.where(leaf_only, 0, code[:, 0]))
     src1 = src1.at[:, 0].set(jnp.where(leaf_only, root_addr, src1[:, 0]))
@@ -160,7 +161,7 @@ def compile_program(trees: TreeBatch, nfeatures: int, n_binary: int,
     cslot = jnp.where(used, order_c[:, :cmax], L).astype(jnp.int32)
     cvals = jnp.where(
         used,
-        jnp.take_along_axis(const, jnp.clip(cslot, 0, L - 1), axis=-1),
+        lane_take(const, jnp.clip(cslot, 0, L - 1)),
         0.0,
     ).astype(const.dtype)
     const_ok = jnp.all(jnp.isfinite(const) | ~is_cleaf, axis=-1)
@@ -178,8 +179,7 @@ def update_consts(prog: TreeProgram, const: jax.Array) -> TreeProgram:
     """
     L = const.shape[-1]
     used = prog.cslot < L
-    gathered = jnp.take_along_axis(
-        const, jnp.clip(prog.cslot, 0, L - 1), axis=-1)
+    gathered = lane_take(const, jnp.clip(prog.cslot, 0, L - 1))
     cvals = jnp.where(used, gathered, 0.0).astype(const.dtype)
     const_ok = jnp.all(jnp.isfinite(gathered) | ~used, axis=-1)
     return dataclasses.replace(prog, cvals=cvals, const_ok=const_ok)

@@ -18,7 +18,7 @@ from symbolicregression_jl_tpu.evolve.population import init_population
 from symbolicregression_jl_tpu.evolve.step import evolve_config_from_options
 from symbolicregression_jl_tpu.ops.encoding import encode_population
 from symbolicregression_jl_tpu.ops.eval import eval_tree_batch
-from symbolicregression_jl_tpu.ops.fused_eval import fused_loss, stack_positions
+from symbolicregression_jl_tpu.ops.fused_eval import fused_loss
 
 
 @pytest.fixture(scope="module")
@@ -34,13 +34,6 @@ def setup():
     X = jnp.asarray(rng.uniform(-3, 3, (3, 257)).astype(np.float32))
     y = jnp.asarray(rng.normal(size=257).astype(np.float32))
     return opts, cfg, X, y
-
-
-def test_stack_positions():
-    # postfix [leaf, leaf, binop, leaf, binop]: ((a op b) op c)
-    arity = jnp.asarray([0, 0, 2, 0, 2])
-    dst = stack_positions(arity)
-    assert dst.tolist() == [0, 1, 0, 1, 0]
 
 
 def test_fused_matches_interpreter_on_exprs(setup):

@@ -1,0 +1,88 @@
+"""Island sharding must not change the search: identical seeds on a
+1-device layout and an 8-device island-sharded mesh must produce the
+same populations and hall of fame.
+
+Islands are data-independent (migration and frequency statistics are the
+only cross-island couplings, and both reduce integer-valued quantities,
+which sum exactly in f32 regardless of shard-induced reduction order),
+so tree STRUCTURES, hall-of-fame contents, and eval counts must agree
+bit-exactly on the virtual CPU mesh the conftest provisions. Constants
+are compared to 1e-5: XLA fuses elementwise chains differently for
+different layouts, which moves optimizer arithmetic by ~1 ULP.
+"""
+
+import numpy as np
+
+import jax
+
+from symbolicregression_jl_tpu import Options, search_key
+from symbolicregression_jl_tpu.core.dataset import make_dataset
+from symbolicregression_jl_tpu.evolve.engine import Engine
+from symbolicregression_jl_tpu.parallel.mesh import (
+    make_mesh,
+    shard_device_data,
+    shard_search_state,
+)
+
+
+def _run(n_island_shards: int):
+    rng = np.random.default_rng(7)
+    X = rng.uniform(-2, 2, (256, 3)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.5 * np.cos(X[:, 2])).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        maxsize=10,
+        populations=8,
+        population_size=16,
+        tournament_selection_n=4,
+        ncycles_per_iteration=4,
+        optimizer_probability=0.3,
+        optimizer_iterations=2,
+        optimizer_nrestarts=1,
+        fraction_replaced=0.1,
+        save_to_file=False,
+    )
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+    mesh = make_mesh(
+        jax.devices()[:n_island_shards],
+        n_island_shards=n_island_shards, n_data_shards=1,
+    )
+    engine = Engine(options, ds.nfeatures)
+    data = shard_device_data(ds.data, mesh)
+    state = engine.init_state(search_key(123), data, options.populations)
+    state = shard_search_state(state, mesh)
+    for _ in range(2):
+        state = engine.run_iteration(state, data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    return jax.device_get(state)
+
+
+def test_island_sharding_is_bit_exact():
+    assert len(jax.devices()) == 8, "conftest virtual mesh not engaged"
+    s1 = _run(1)
+    s8 = _run(8)
+
+    for field in ("arity", "op", "feat", "length"):
+        a = np.asarray(getattr(s1.pops.trees, field))
+        b = np.asarray(getattr(s8.pops.trees, field))
+        assert np.array_equal(a, b), f"pops.trees.{field} diverged"
+    np.testing.assert_allclose(
+        np.asarray(s1.pops.trees.const), np.asarray(s8.pops.trees.const),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(s1.pops.cost), np.asarray(s8.pops.cost),
+        rtol=1e-5, atol=1e-6)
+
+    assert np.array_equal(np.asarray(s1.hof.exists),
+                          np.asarray(s8.hof.exists))
+    np.testing.assert_allclose(
+        np.asarray(s1.hof.cost), np.asarray(s8.hof.cost),
+        rtol=1e-5, atol=1e-6)
+    for field in ("arity", "op", "feat", "length"):
+        assert np.array_equal(
+            np.asarray(getattr(s1.hof.trees, field)),
+            np.asarray(getattr(s8.hof.trees, field)),
+        ), f"hof.trees.{field} diverged"
+    assert float(s1.num_evals) == float(s8.num_evals)
