@@ -33,6 +33,7 @@ import argparse
 import json
 import os
 import subprocess
+import tempfile
 import sys
 import time
 
@@ -86,6 +87,13 @@ def single_run(problem: str, platform: str, seed: int, budget_s: float):
     import jax
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache: seeds/problems share executables, so the
+    # per-subprocess compile cost amortizes across the suite (per-user
+    # path — a world-shared one breaks on multi-user hosts)
+    cache = os.path.join(
+        tempfile.gettempdir(), f"jax_quality_cache_{os.getuid()}")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     from symbolicregression_jl_tpu import Options, search_key
     from symbolicregression_jl_tpu.core.dataset import make_dataset

@@ -25,6 +25,19 @@ from .search import RuntimeOptions, SearchState, equation_search
 __all__ = ["SRRegressor", "MultitargetSRRegressor", "choose_best"]
 
 
+def _coerce_table(X):
+    """(values [n, F], column_names | None) from array-likes or column
+    tables (pandas DataFrame, dict of columns) — the MLJ table-input
+    analogue (src/MLJInterface.jl:366-380)."""
+    if hasattr(X, "columns") and hasattr(X, "to_numpy"):  # DataFrame
+        return X.to_numpy(), [str(c) for c in X.columns]
+    if isinstance(X, dict):
+        names = list(X)
+        cols = [np.asarray(X[k]).reshape(-1) for k in names]
+        return np.stack(cols, axis=1), [str(n) for n in names]
+    return np.asarray(X), None
+
+
 def choose_best(
     *, trees, losses, scores, complexities, options: Optional[Options] = None
 ) -> int:
@@ -104,6 +117,8 @@ class SRRegressor:
         self.variable_names_: Optional[Sequence[str]] = None
         self.fitted_iterations_: int = 0
         self.classes_: Optional[np.ndarray] = None
+        self.y_units_ = None
+        self._named_fit_ = False
 
     # ------------------------------------------------------------------
     def _make_options(self) -> Options:
@@ -120,7 +135,10 @@ class SRRegressor:
         y_units=None,
         category=None,
     ) -> "SRRegressor":
-        X = np.asarray(X)
+        X, table_names = _coerce_table(X)
+        if variable_names is None and table_names is not None:
+            variable_names = table_names
+        self._named_fit_ = variable_names is not None
         y = np.asarray(y)
         if self._MULTITARGET:
             if y.ndim != 2:
@@ -151,6 +169,7 @@ class SRRegressor:
             if variable_names is not None
             else [f"x{i + 1}" for i in range(X.shape[1])]
         )
+        self.y_units_ = y_units
 
         extra = None
         self.classes_ = None
@@ -300,10 +319,30 @@ class SRRegressor:
         return out
 
     def predict(self, X, idx: Optional[Union[int, Sequence[int]]] = None,
-                *, category=None):
-        """Predict with the selected (or ``idx``-chosen) equation."""
+                *, category=None, with_units: bool = False):
+        """Predict with the selected (or ``idx``-chosen) equation.
+
+        Column tables (pandas DataFrames / dicts of columns) are
+        accepted and reordered by the fitted variable names. With
+        ``with_units=True`` (and ``y_units`` given at fit) the result is
+        a :class:`~..core.units.QuantityArray` echoing those units —
+        the unit-typed predict round-trip of the reference
+        (src/MLJInterface.jl:366-380).
+        """
         self._check_fitted()
-        X = np.asarray(X)
+        X, table_names = _coerce_table(X)
+        if table_names is not None and self.variable_names_ is not None:
+            if set(self.variable_names_) <= set(table_names):
+                order = [table_names.index(n) for n in self.variable_names_]
+                X = X[:, order]
+            elif self._named_fit_:
+                # The fit was name-aware: a silent positional fallback
+                # would feed columns into the wrong variables (the MLJ
+                # reference errors on name mismatch too).
+                raise ValueError(
+                    f"Prediction table columns {table_names} do not cover "
+                    f"the fitted variable names {list(self.variable_names_)}"
+                )
         if self._MULTITARGET:
             if idx is None:
                 idxs = list(self.best_idx_)
@@ -315,9 +354,15 @@ class SRRegressor:
                 self._predict_one(recs, i, X, category)
                 for recs, i in zip(self.equations_, idxs)
             ]
-            return np.stack(outs, axis=1)
-        i = int(idx) if idx is not None else int(self.best_idx_)
-        return self._predict_one(self.equations_, i, X, category)
+            out = np.stack(outs, axis=1)
+        else:
+            i = int(idx) if idx is not None else int(self.best_idx_)
+            out = self._predict_one(self.equations_, i, X, category)
+        if with_units and self.y_units_ is not None:
+            from ..core.units import QuantityArray
+
+            return QuantityArray(out, self.y_units_)
+        return out
 
     def score(self, X, y, *, sample_weight=None, category=None) -> float:
         """Coefficient of determination R^2 (sklearn convention)."""

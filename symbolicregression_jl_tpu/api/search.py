@@ -601,22 +601,24 @@ def equation_search(
                 return "max_evals"
         return None
 
-    # With a budget configured, split each iteration's evolve phase into
-    # chunks with the budget polled between launches, so a timeout /
-    # max_evals / user-quit can't overshoot by a whole iteration (the
-    # reference checks once per dispatched cycle batch,
-    # src/SymbolicRegression.jl:1202-1209). The engine keeps chunked and
-    # single-launch iterations bit-identical (global cycle indices; one
-    # epilogue), so this changes only check granularity, not results.
-    budgeted = (
-        options.timeout_in_seconds is not None
-        or options.max_evals is not None
-        or watcher.active
-    )
-    n_chunks = min(4, options.ncycles_per_iteration) if budgeted else 1
-    base, rem = divmod(options.ncycles_per_iteration, n_chunks)
-    chunk_sizes = [base + (1 if c < rem else 0) for c in range(n_chunks)]
-    chunk_sizes = [c for c in chunk_sizes if c > 0]
+    # ALWAYS split each iteration's evolve phase into chunks with the
+    # budget polled between launches, so a timeout / max_evals /
+    # user-quit can't overshoot by a whole iteration (the reference
+    # checks once per dispatched cycle batch,
+    # src/SymbolicRegression.jl:1202-1209). The chunk count adapts to
+    # the measured iteration time, targeting ~1 s stop latency; launch
+    # machinery is a small fraction of device time at these counts. The
+    # engine keeps chunked and single-launch iterations bit-identical
+    # (global cycle indices; one epilogue), so chunking — and re-chunking
+    # between iterations — changes only check granularity, not results.
+    _STOP_LATENCY_TARGET_S = 1.0
+    _MAX_CHUNKS = 16
+    n_chunks = min(4, options.ncycles_per_iteration)
+
+    def _chunk_sizes():
+        base, rem = divmod(options.ncycles_per_iteration, n_chunks)
+        sizes = [base + (1 if c < rem else 0) for c in range(n_chunks)]
+        return [c for c in sizes if c > 0]
 
     def _budget_hit(pending_evals=None) -> bool:
         nonlocal stop_reason
@@ -639,6 +641,7 @@ def equation_search(
         )
         dev_t0 = time.time()
         monitor_host = dev_t0 - host_t0  # bookkeeping since last iteration
+        chunk_sizes = _chunk_sizes()
         for j, (engine, data) in enumerate(zip(engines, datas)):
             states[j] = engine.run_iteration(
                 states[j], data, cur_maxsize,
@@ -647,6 +650,18 @@ def equation_search(
             )
         jax.block_until_ready(states[-1].pops.cost)
         host_t0 = time.time()
+        # Adapt chunk count toward the stop-latency target using this
+        # iteration's measured device time, quantized to powers of two —
+        # each distinct chunk size compiles its own evolve-part, so the
+        # count must not wander with timing noise. The first iteration's
+        # measurement is dominated by one-time jit compilation and is
+        # skipped.
+        if it >= 1:  # it not yet incremented: 0 == first iteration
+            target = (host_t0 - dev_t0) / _STOP_LATENCY_TARGET_S
+            cap = min(options.ncycles_per_iteration, _MAX_CHUNKS)
+            n_chunks = 1
+            while n_chunks < cap and n_chunks * 2 <= target:
+                n_chunks *= 2
         monitor.record(host_t0 - dev_t0, monitor_host)
         monitor.check_and_warn(ropt.verbosity)
         cycles_remaining -= options.ncycles_per_iteration

@@ -274,3 +274,23 @@ def units_to_dims_arrays(
         x_dims = np.stack([dims_to_array(parse_unit(u).dims) for u in X_units])
     y_dims = None if y_units is None else dims_to_array(parse_unit(y_units).dims)
     return x_dims, y_dims
+
+
+class QuantityArray(np.ndarray):
+    """A numpy array carrying a unit specification string.
+
+    The Python face of the reference's unit-typed MLJ predictions
+    (src/MLJInterface.jl:366-380): predictions echo the ``y_units``
+    given at fit time via ``.unit`` while behaving as plain arrays
+    everywhere else.
+    """
+
+    def __new__(cls, values, unit):
+        obj = np.asarray(values).view(cls)
+        obj.unit = unit
+        return obj
+
+    def __array_finalize__(self, obj):
+        if obj is None:
+            return
+        self.unit = getattr(obj, "unit", None)

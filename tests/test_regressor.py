@@ -115,3 +115,38 @@ def test_latex_and_export(problem):
         assert expr is not None
     except ImportError:
         pass
+
+
+def test_dataframe_inputs_and_column_names(problem):
+    """MLJ-style column tables: a pandas DataFrame fits directly, its
+    column names become the variable names, and predict reorders a
+    permuted-column frame by them (src/MLJInterface.jl:366-380)."""
+    pd = pytest.importorskip("pandas")
+    X, y = problem
+    df = pd.DataFrame({"alpha": X[:, 0], "beta": X[:, 1]})
+    model = SRRegressor(niterations=2, seed=0, **_opts())
+    model.fit(df, y)
+    assert model.variable_names_ == ["alpha", "beta"]
+    pred = model.predict(df)
+    # permuted columns must give the same predictions
+    pred_permuted = model.predict(df[["beta", "alpha"]])
+    np.testing.assert_allclose(pred, pred_permuted)
+    # dict-of-columns tables work too
+    pred_dict = model.predict({"beta": X[:, 1], "alpha": X[:, 0]})
+    np.testing.assert_allclose(pred, pred_dict)
+
+
+def test_units_echo_through_predict(problem):
+    """y_units given at fit echo on predictions with with_units=True —
+    the reference's unit-typed predict round-trip."""
+    from symbolicregression_jl_tpu.core.units import QuantityArray
+
+    X, y = problem
+    model = SRRegressor(niterations=2, seed=0, **_opts())
+    model.fit(X, y, X_units=["m", "s"], y_units="m/s")
+    out = model.predict(X, with_units=True)
+    assert isinstance(out, QuantityArray)
+    assert out.unit == "m/s"
+    plain = model.predict(X)
+    np.testing.assert_allclose(np.asarray(out), plain)
+    assert not isinstance(plain, QuantityArray)

@@ -113,3 +113,27 @@ def test_chunked_iteration_bit_identical():
     np.testing.assert_array_equal(
         jax.random.key_data(s1.key), jax.random.key_data(s2.key)
     )
+
+
+def test_default_search_is_chunked(monkeypatch):
+    """Stop checks run mid-iteration EVEN WITHOUT a configured budget:
+    the evolve phase is always chunked (adaptive count, ~1 s stop
+    latency target), so a later 'q'/timeout can interrupt promptly."""
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+
+    seen = []
+    orig = Engine.run_iteration
+
+    def spy(self, state, data, cur_maxsize, chunk_sizes=None,
+            should_stop=None):
+        seen.append(chunk_sizes)
+        return orig(self, state, data, cur_maxsize,
+                    chunk_sizes=chunk_sizes, should_stop=should_stop)
+
+    monkeypatch.setattr(Engine, "run_iteration", spy)
+    X, y = _problem()
+    equation_search(
+        X, y, options=_options(ncycles_per_iteration=8),
+        runtime_options=RuntimeOptions(niterations=2, verbosity=0, seed=0),
+    )
+    assert seen and seen[0] is not None and len(seen[0]) > 1
