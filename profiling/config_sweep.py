@@ -44,6 +44,11 @@ def main():
         dict(populations=512, population_size=128, tournament_selection_n=8,
              mutation_attempts=3),
         dict(populations=256, population_size=256, tournament_selection_n=16),
+        dict(populations=512, population_size=256, tournament_selection_n=16),
+        dict(populations=384, population_size=256, tournament_selection_n=16),
+        dict(populations=512, population_size=192, tournament_selection_n=16),
+        dict(populations=256, population_size=256, tournament_selection_n=16,
+             optimizer_probability=0.2),
     ]
     if len(sys.argv) > 1:  # subset by index
         configs = [configs[int(i)] for i in sys.argv[1:]]
