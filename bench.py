@@ -59,8 +59,8 @@ def main() -> None:
         binary_operators=["+", "-", "*", "/"],
         unary_operators=["exp", "abs", "cos"],
         maxsize=30,
-        populations=256,
-        population_size=256,
+        populations=512,   # island count peaks at 512 on v5e-1
+        population_size=256,  # (profiling/config_sweep.py, round 3)
         tournament_selection_n=16,
         ncycles_per_iteration=100,
         save_to_file=False,

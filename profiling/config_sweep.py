@@ -49,6 +49,13 @@ def main():
         dict(populations=512, population_size=192, tournament_selection_n=16),
         dict(populations=256, population_size=256, tournament_selection_n=16,
              optimizer_probability=0.2),
+        dict(populations=768, population_size=256, tournament_selection_n=16),
+        dict(populations=1024, population_size=256, tournament_selection_n=16),
+        dict(populations=1024, population_size=128, tournament_selection_n=16),
+        dict(populations=512, population_size=256, tournament_selection_n=16,
+             optimizer_probability=0.2),
+        dict(populations=512, population_size=256, tournament_selection_n=16,
+             optimizer_probability=0.3),
     ]
     if len(sys.argv) > 1:  # subset by index
         configs = [configs[int(i)] for i in sys.argv[1:]]

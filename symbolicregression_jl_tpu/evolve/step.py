@@ -16,6 +16,7 @@ best-seen-per-complexity mini hall of fame on device.
 
 from __future__ import annotations
 
+import functools
 import dataclasses
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
@@ -278,10 +279,22 @@ def _apply_kind(kind, u_all, tree: TreeBatch, temperature, cur_maxsize,
 
 
 def _first_valid(valid, stacked: TreeBatch, fallback: TreeBatch):
-    """Select the first attempt with valid=True, else fallback."""
+    """Select the first attempt with valid=True, else fallback.
+
+    One-hot select over the (small) attempt axis: a traced-scalar index
+    here becomes a batched dynamic gather under the (island, slot) vmaps,
+    which XLA serializes on TPU (see ops.encoding.lane_take)."""
     any_valid = jnp.any(valid)
     first = jnp.argmax(valid)
-    picked = jax.tree.map(lambda x: x[first], stacked)
+    A = valid.shape[0]
+    oh = jnp.arange(A) == first
+
+    def pick(x):
+        ohx = oh.reshape((A,) + (1,) * (x.ndim - 1))
+        return jnp.sum(jnp.where(ohx, x, jnp.zeros((), x.dtype)),
+                       axis=0).astype(x.dtype)
+
+    picked = jax.tree.map(pick, stacked)
     return M._select_tree(any_valid, picked, fallback), any_valid
 
 
@@ -441,6 +454,28 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
 # ---------------------------------------------------------------------------
 
 
+def _onehot_rows_i(oh, x):
+    """Integer-field row gather via one-hot matmul at HIGHEST precision
+    (the default TPU matmul rounds f32 operands to bfloat16, which is
+    only exact for integers up to 256); round() recovers the ints."""
+    n = x.shape[0]
+    out = jnp.round(jnp.matmul(oh, x.reshape(n, -1).astype(oh.dtype),
+                               precision=jax.lax.Precision.HIGHEST))
+    return out.astype(x.dtype).reshape((oh.shape[0],) + x.shape[1:])
+
+
+def _onehot_rows_f(oh, x):
+    """Float-field row gather via one-hot matmul. Sources are clamped:
+    0 * inf = NaN would leak one row's overflowed value into every
+    output row; callers that must preserve the NaN *verdict* of a
+    selected row track it separately (see the crossover pack)."""
+    n = x.shape[0]
+    xf = jnp.nan_to_num(x.reshape(n, -1).astype(oh.dtype),
+                        nan=3.0e38, posinf=3.0e38, neginf=-3.0e38)
+    out = jnp.matmul(oh, xf, precision=jax.lax.Precision.HIGHEST)
+    return out.astype(x.dtype).reshape((oh.shape[0],) + x.shape[1:])
+
+
 def _member_take_onehot(pop: PopulationState, idx: jax.Array, P: int
                         ) -> PopulationState:
     """Batched ``pop.member(idx[b])`` for all slots at once.
@@ -453,21 +488,11 @@ def _member_take_onehot(pop: PopulationState, idx: jax.Array, P: int
     the float matmul.
     """
     oh = jax.nn.one_hot(idx, P, dtype=pop.trees.const.dtype)  # [B, P]
-    B = idx.shape[0]
-
-    def take_tree_i(x):
-        out = jnp.round(oh @ x.reshape(P, -1).astype(oh.dtype))
-        return out.astype(x.dtype).reshape((B,) + x.shape[1:])
-
-    def take_tree_f(x):
-        # 0 * inf = NaN would leak a single member's overflowed constant
-        # into EVERY selected parent through the matmul; clamp source
-        # non-finites to a huge finite value first — the affected
-        # member's own evals overflow to invalid either way, everyone
-        # else's rows are exact.
-        xf = x.reshape(P, -1).astype(oh.dtype)
-        xf = jnp.nan_to_num(xf, nan=3.0e38, posinf=3.0e38, neginf=-3.0e38)
-        return (oh @ xf).astype(x.dtype).reshape((B,) + x.shape[1:])
+    take_tree_i = functools.partial(_onehot_rows_i, oh)
+    # Clamped-gather semantics for floats: a parent with overflowed
+    # constants yields huge-but-finite copies whose candidate evals go
+    # invalid, same outcome as the NaN the old gather propagated.
+    take_tree_f = functools.partial(_onehot_rows_f, oh)
 
     take = lambda x: jnp.take(x, idx, axis=0)
     return PopulationState(
@@ -703,8 +728,25 @@ def generation_step(
 
     if 0 < k2 < B:
         _, sel2 = jax.lax.top_k(is_xover.astype(jnp.float32), k2)
-        cand2_sel = jax.tree.map(lambda x: x[sel2], cand2)
-        params2_sel = cand2_params[sel2]
+        # One-hot matmul row-take (vmapped fancy-index gathers serialize
+        # on TPU; a where+masked-sum materializes [k2, B, L] per field).
+        # HIGHEST precision keeps the f32 pass exact; sources are clamped
+        # (0 * inf = NaN would leak across rows), and the rows that DID
+        # carry non-finite constants are tracked explicitly so the
+        # xo_nan rejection below still fires for them.
+        oh2 = jax.nn.one_hot(sel2, B, dtype=cand2.const.dtype)  # [k2, B]
+        cand2_sel = TreeBatch(
+            arity=_onehot_rows_i(oh2, cand2.arity),
+            op=_onehot_rows_i(oh2, cand2.op),
+            feat=_onehot_rows_i(oh2, cand2.feat),
+            const=_onehot_rows_f(oh2, cand2.const),
+            length=_onehot_rows_i(oh2, cand2.length),
+        )
+        params2_sel = _onehot_rows_f(oh2, cand2_params)
+        slot_bad2 = (
+            ~jnp.all(jnp.isfinite(cand2.const.reshape(B, -1)), axis=1)
+            | ~jnp.all(jnp.isfinite(cand2_params.reshape(B, -1)), axis=1)
+        )  # [B] per original slot; scattered onto cost below
         packed = jax.tree.map(
             lambda a, b: jnp.concatenate([a, b], axis=0), cand1, cand2_sel
         )  # [B + k2, ...]
@@ -719,6 +761,11 @@ def generation_step(
         cost = unpack(c_all, inf)
         loss = unpack(l_all, inf)
         complexity = unpack(x_all, jnp.int32(1))
+        # rows whose raw cand2 carried non-finite constants/params were
+        # evaluated on clamped copies; restore the NaN verdict so the
+        # xo_nan rejection matches an un-clamped gather
+        cost = cost.at[:, 1].set(
+            jnp.where(slot_bad2, jnp.nan, cost[:, 1]))
         # slots beyond the pool didn't get cand2 evaluated: treat as a
         # failed crossover (no replacement, no eval counted)
         xover_rank = jnp.cumsum(is_xover.astype(jnp.int32)) - 1
