@@ -437,12 +437,13 @@ class Engine:
                 sel_marked = jnp.take_along_axis(opt_mark, sel_idx, axis=1)
                 gate = gate | sel_marked
 
-            if cfg.turbo and cfg.template is None:
+            if cfg.turbo and cfg.template is None and cfg.n_params == 0:
                 # One flattened launch across all islands: the fused BFGS
                 # batches its line search through the Pallas kernel.
-                # (Templates always take the jnp branch below — their
-                # joint constant+parameter optimization differentiates
-                # through the combiner.)
+                # (Templates and parametric members always take the jnp
+                # branch below — their joint constant+parameter
+                # optimization differentiates through the combiner /
+                # parameter gathers.)
                 sub = jax.vmap(
                     lambda t, i: jax.tree.map(
                         lambda x: jnp.take(x, i, axis=0), t

@@ -118,12 +118,11 @@ def evolve_config_from_options(options: Options, nfeatures: int,
         turbo = False
     if options.loss_function is not None or options.loss_function_expression is not None:
         turbo = False  # custom whole-prediction losses use the jnp path
-    if n_params > 0 and template is None:
-        turbo = False  # parameter-leaf gather uses the jnp interpreter
-    # (templates keep turbo: the batched template evaluator routes
-    # shared-argument call sites through the fused predict kernel, and
-    # the template constant optimizer's gradients go through
-    # fused_predict_ad's cotangent-seeded backward kernel)
+    # (Parametric members keep turbo: LEAF_PARAM leaves address the
+    # fused kernel's parameter buffer region — see ops/program.py. Their
+    # constant+parameter optimization still runs the jnp path, gated in
+    # engine.py. Templates keep turbo: the batched template evaluator
+    # routes call sites through the fused predict kernel.)
     if n_data_shards > 1:
         # Documented fallback: `pl.pallas_call` does not compose with
         # GSPMD row-sharded operands (it would need a shard_map wrapper
@@ -415,18 +414,26 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
         cost = loss_to_cost(loss, data.baseline_loss, data.use_baseline,
                             complexity, parsimony)
         return cost, loss, complexity
-    params = None
-    if member_params is not None and member_params.shape[-2] > 0:
-        if class_idx is None:
-            raise ValueError(
-                "Parametric evaluation requires a `class` column in the dataset"
-            )
-        params = jnp.take(member_params, class_idx, axis=-1)  # [..., K, n]
-    if turbo and params is None and loss_function is None:
+    has_params = member_params is not None and member_params.shape[-2] > 0
+    if has_params and class_idx is None:
+        raise ValueError(
+            "Parametric evaluation requires a `class` column in the dataset"
+        )
+    if turbo and loss_function is None:
+        # Parametric members ride the fused kernel too: their banks
+        # materialize as per-row buffer region values inside the kernel
+        # (class one-hot contraction), no [T, NP, n] HBM buffers.
         loss, valid = fused_loss(
-            trees, X, y, w, operators, elementwise_loss, interpret=interpret
+            trees, X, y, w, operators, elementwise_loss,
+            params=member_params if has_params else None,
+            class_idx=class_idx if has_params else None,
+            interpret=interpret,
         )
     else:
+        params = (
+            jnp.take(member_params, class_idx, axis=-1)  # [..., K, n]
+            if has_params else None
+        )
         pred, valid = eval_tree_batch(trees, X, operators, params=params)
         loss = _loss_from_pred(pred, valid)
     complexity = compute_complexity_batch(trees, tables)

@@ -134,23 +134,23 @@ def _make_program_kernel(
     tree_block: int,
     nfeat: int,
     cmax: int,
+    nparam: int = 0,
+    nclass: int = 0,
 ):
-    BASE = nfeat + cmax
+    CBASE = nfeat + nparam
+    BASE = CBASE + cmax
 
-    def kernel(
-        instr_ref,   # SMEM [TB, L] packed instruction words
-        nstep_ref,   # SMEM [TB, 1]
-        nconst_ref,  # SMEM [TB, 1]
-        cvals_ref,   # SMEM [TB, CMAX] f32
-        ok_ref,      # SMEM [TB, 1] int32 — const_ok from the program
-        x_ref,       # VMEM [F, TILE]
-        y_ref,       # VMEM [1, TILE]
-        w_ref,       # VMEM [1, TILE]
-        mask_ref,    # VMEM [1, TILE] f32: 1.0 real rows
-        loss_ref,    # SMEM out [TB, 1] f32
-        valid_ref,   # SMEM out [TB, 1] int32
-        buf_ref,     # VMEM scratch [BASE + L, TILE]
-    ):
+    def kernel(*refs):
+        if nparam > 0:
+            (instr_ref, nstep_ref, nconst_ref, cvals_ref, ok_ref,
+             pbank_ref,  # SMEM [TB, NP * NC] f32 — per-tree param banks
+             x_ref, clsoh_ref,  # VMEM [NC, TILE] f32 class one-hots
+             y_ref, w_ref, mask_ref,
+             loss_ref, valid_ref, buf_ref) = refs
+        else:
+            (instr_ref, nstep_ref, nconst_ref, cvals_ref, ok_ref,
+             x_ref, y_ref, w_ref, mask_ref,
+             loss_ref, valid_ref, buf_ref) = refs
         j = pl.program_id(1)
         y_row = y_ref[0, :]
         mask_row = mask_ref[0, :] > 0
@@ -161,8 +161,20 @@ def _make_program_kernel(
         buf_ref[0:nfeat, :] = x_ref[...]
 
         for t in range(tree_block):
+            if nparam > 0:
+                # Param region: per-row values selected by class —
+                # bank[t, p, c] summed over the class one-hot rows
+                # (ParametricExpression eval,
+                # /root/reference/src/ParametricExpression.jl:63-73).
+                for p_i in range(nparam):
+                    row = clsoh_ref[0, :] * pbank_ref[t, p_i * nclass]
+                    for c in range(1, nclass):
+                        row = row + (clsoh_ref[c, :]
+                                     * pbank_ref[t, p_i * nclass + c])
+                    buf_ref[nfeat + p_i, :] = row
+
             def cbody(c, _):
-                buf_ref[nfeat + c, :] = jnp.full(
+                buf_ref[CBASE + c, :] = jnp.full(
                     (tile,), cvals_ref[t, c], dtype=y_row.dtype)
                 return 0
 
@@ -181,7 +193,9 @@ def _make_program_kernel(
             # 2x-unrolled loop: the scalar-core loop overhead is a real
             # fraction of the ~hundreds of cycles each step costs. Odd
             # tails re-execute a clamped step idempotently (identity-coded
-            # padding rows read a real, finite address).
+            # padding rows read a real address; non-finite values there —
+            # possible only via param/const rows the wrapper already
+            # flags invalid — at worst re-poison an already-dead vmask).
             def pair(k2, vmask):
                 vmask = step(2 * k2, vmask)
                 vmask = step(jnp.minimum(2 * k2 + 1, L - 1), vmask)
@@ -225,16 +239,23 @@ def fused_loss_program(
     operators: OperatorSet,
     loss_fn: Callable,
     *,
+    params: Optional[jax.Array] = None,     # [T, NP, NC] member banks
+    class_oh: Optional[jax.Array] = None,   # [NC, n] class one-hots
     tree_block: int = 8,
     tile_rows: int = 16384,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Mean elementwise loss per compiled tree program (flat [T])."""
+    """Mean elementwise loss per compiled tree program (flat [T]).
+
+    Parametric trees pass per-member banks + class one-hot rows; the
+    program must have been compiled with the matching ``n_params``."""
     T, L = prog.code.shape
     CMAX = prog.cmax
     F, n = X.shape
     dtype = X.dtype
-    BASE = nfeatures + CMAX
+    NP = 0 if params is None else params.shape[-2]
+    NC = 0 if params is None else params.shape[-1]
+    BASE = nfeatures + NP + CMAX
     _check_packable(operators, BASE, L)
 
     TB = tree_block
@@ -261,28 +282,40 @@ def fused_loss_program(
     maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
 
     grid = (T_pad // TB, n_pad // TILE)
-    kernel = _make_program_kernel(operators, loss_fn, TB, nfeatures, CMAX)
+    kernel = _make_program_kernel(operators, loss_fn, TB, nfeatures, CMAX,
+                                  NP, NC)
 
     smem_i32 = lambda shape: pl.BlockSpec(
         shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
     )
     row_spec = pl.BlockSpec((1, TILE), lambda i, j: (0, j))
 
+    in_specs = [
+        smem_i32((TB, L)),                       # instr
+        smem_i32((TB, 1)),                       # nsteps
+        smem_i32((TB, 1)),                       # nconst
+        pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0),
+                     memory_space=pltpu.SMEM),   # cvals
+        smem_i32((TB, 1)),                       # const_ok
+    ]
+    operands = [instr, nsteps, nconst, cvals, ok]
+    if NP > 0:
+        in_specs.append(pl.BlockSpec((TB, NP * NC), lambda i, j: (i, 0),
+                                     memory_space=pltpu.SMEM))  # pbank
+        operands.append(pad_t(params.reshape(T, NP * NC)).astype(dtype))
+    in_specs.append(pl.BlockSpec((F, TILE), lambda i, j: (0, j)))  # X
+    operands.append(Xp)
+    if NP > 0:
+        in_specs.append(pl.BlockSpec((NC, TILE), lambda i, j: (0, j)))
+        operands.append(
+            jnp.pad(class_oh.astype(dtype), ((0, 0), (0, n_pad - n))))
+    in_specs += [row_spec, row_spec, row_spec]   # y, w, mask
+    operands += [yp, wp, maskp]
+
     loss_sum, valid = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            smem_i32((TB, L)),                       # instr
-            smem_i32((TB, 1)),                       # nsteps
-            smem_i32((TB, 1)),                       # nconst
-            pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),   # cvals
-            smem_i32((TB, 1)),                       # const_ok
-            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),  # X
-            row_spec,                                # y
-            row_spec,                                # w
-            row_spec,                                # mask
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
                          memory_space=pltpu.SMEM),
@@ -295,7 +328,7 @@ def fused_loss_program(
         ],
         scratch_shapes=[pltpu.VMEM((BASE + L, TILE), dtype)],
         interpret=interpret,
-    )(instr, nsteps, nconst, cvals, ok, Xp, yp, wp, maskp)
+    )(*operands)
 
     loss_sum = loss_sum[:T, 0]
     valid = valid[:T, 0].astype(jnp.bool_)
@@ -851,6 +884,8 @@ def fused_loss(
     operators: OperatorSet,
     loss_fn: Callable,
     *,
+    params: Optional[jax.Array] = None,     # [..., NP, NC] member banks
+    class_idx: Optional[jax.Array] = None,  # [n] int class per row
     tree_block: int = 8,
     tile_rows: int = 16384,
     interpret: bool = False,
@@ -863,15 +898,35 @@ def fused_loss(
     runs the unified-buffer kernel; callers that re-evaluate the same
     structures with different constants (line searches) should compile
     once and use `fused_loss_program` + `update_consts` directly.
+
+    Parametric members pass their banks ``params`` and the dataset's
+    per-row ``class_idx``; LEAF_PARAM leaves then read per-row values
+    from the buffer's parameter region.
     """
     batch_shape = trees.batch_shape
     flat = trees.reshape(-1) if batch_shape else trees.reshape(1)
     F = X.shape[0]
-    prog = compile_program(flat, F, len(operators.binary))
+    NP = 0 if params is None else params.shape[-2]
+    prog = compile_program(flat, F, len(operators.binary), n_params=NP)
+    p_flat = None
+    class_oh = None
+    if NP > 0:
+        NC = params.shape[-1]
+        p_flat = params.reshape(-1, NP, NC)
+        class_oh = (class_idx[None, :] == jnp.arange(NC)[:, None]).astype(
+            X.dtype)
     loss, valid = fused_loss_program(
         prog, X, y, weights, F, operators, loss_fn,
+        params=p_flat, class_oh=class_oh,
         tree_block=tree_block, tile_rows=tile_rows, interpret=interpret,
     )
+    if NP > 0:
+        # const_ok analogue for the parameter region: a non-finite bank
+        # value absorbed by an op (exp(-inf) = 0) would otherwise pass
+        # as valid where the interpreter flags the param node itself.
+        p_ok = jnp.all(jnp.isfinite(p_flat), axis=(-2, -1))
+        valid = valid & p_ok
+        loss = jnp.where(valid, loss, jnp.inf)
     if batch_shape:
         return loss.reshape(batch_shape), valid.reshape(batch_shape)
     return loss[0], valid[0]

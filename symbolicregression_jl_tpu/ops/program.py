@@ -52,8 +52,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from .encoding import (LEAF_VAR, TreeBatch, _structure_from_arity,
-                       lane_take)
+from .encoding import (LEAF_CONST, LEAF_PARAM, LEAF_VAR, TreeBatch,
+                       _structure_from_arity, lane_take)
 
 __all__ = ["TreeProgram", "compile_program", "update_consts",
            "const_mask_compressed", "scatter_const_grads", "program_cmax"]
@@ -93,37 +93,49 @@ class TreeProgram:
 
 
 def compile_program(trees: TreeBatch, nfeatures: int, n_binary: int,
-                    ) -> TreeProgram:
+                    n_params: int = 0) -> TreeProgram:
     """Lower a flat [T, L] TreeBatch to a TreeProgram (all jnp, jittable).
 
     Single-leaf trees compile to one identity step copying the leaf's
     address; `nsteps` is therefore always >= 1 and the root value lives
     at buffer slot ``BASE + nsteps - 1``.
 
-    LEAF_PARAM leaves are treated as constant leaves (their `const`
-    field); callers on the parametric path must materialize parameter
-    values into `const` first (the turbo gate in evolve/step.py keeps
-    un-materialized parametric trees off this path).
+    With ``n_params > 0`` the buffer gains a parameter region between
+    the X rows and the const region — ``[X(F) | params(NP) | consts |
+    internal]`` — and LEAF_PARAM leaves address it by parameter index;
+    the kernels materialize those rows per tree from the member's
+    parameter bank and the dataset's class one-hots. With ``n_params ==
+    0`` LEAF_PARAM leaves alias constant leaves (their `const` field) —
+    the historical contract for callers that pre-materialize.
     """
+    from .encoding import LEAF_CONST, LEAF_PARAM
+
     arity, op, feat, const, length = (
         trees.arity, trees.op, trees.feat, trees.const, trees.length)
     T, L = arity.shape
     cmax = program_cmax(L)
-    BASE = nfeatures + cmax
+    CBASE = nfeatures + n_params
+    BASE = CBASE + cmax
     slot = jnp.arange(L, dtype=jnp.int32)
 
     live = slot[None, :] < length[:, None]
     internal = live & (arity > 0)
     ci = jnp.cumsum(internal, axis=-1) - internal          # compressed idx
-    is_cleaf = live & (arity == 0) & (op != LEAF_VAR)
+    if n_params > 0:
+        is_cleaf = live & (arity == 0) & (op == LEAF_CONST)
+    else:
+        is_cleaf = live & (arity == 0) & (op != LEAF_VAR)
     cj = jnp.cumsum(is_cleaf, axis=-1) - is_cleaf          # const idx
 
     # Unified buffer address of every slot's value.
-    addr = jnp.where(
-        internal, BASE + ci,
-        jnp.where(op == LEAF_VAR, jnp.clip(feat, 0, nfeatures - 1),
-                  nfeatures + jnp.clip(cj, 0, cmax - 1)),
-    ).astype(jnp.int32)
+    leaf_addr = jnp.where(
+        op == LEAF_VAR, jnp.clip(feat, 0, nfeatures - 1),
+        CBASE + jnp.clip(cj, 0, cmax - 1))
+    if n_params > 0:
+        leaf_addr = jnp.where(
+            op == LEAF_PARAM,
+            nfeatures + jnp.clip(feat, 0, n_params - 1), leaf_addr)
+    addr = jnp.where(internal, BASE + ci, leaf_addr).astype(jnp.int32)
 
     child, _, _ = _structure_from_arity(arity, need_depth=False)
     code_slot = jnp.where(

@@ -228,3 +228,66 @@ def test_hof_csv_params_roundtrip_seeds_guesses(tmp_path, ops):
     assert any(
         np.allclose(row, bank.reshape(-1), atol=1e-5) for row in flat
     ), "seeded parameter bank not found in the population"
+
+
+def test_fused_parametric_loss_matches_interpreter(ops):
+    """Turbo parametric eval: LEAF_PARAM leaves read the fused kernel's
+    parameter buffer region (class one-hot contraction) — must agree
+    with the class-gathered jnp interpreter."""
+    from symbolicregression_jl_tpu.core.losses import (
+        aggregate_loss, l2_dist_loss)
+    from symbolicregression_jl_tpu.ops.fused_eval import fused_loss
+
+    rng = np.random.default_rng(0)
+    n = 257
+    X = jnp.asarray(rng.uniform(-2, 2, (2, n)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    cls = jnp.asarray(rng.integers(0, 3, n), jnp.int32)
+    trees = encode_population([
+        parse_expression("p1 + (x1 * p2)", ops),
+        parse_expression("cos(p2) * x2", ops),
+        parse_expression("p1", ops),  # single-leaf param tree
+        parse_expression("x1 + 1.5", ops),  # no params at all
+    ], 10, ops)
+    params = jnp.asarray(
+        rng.normal(size=(4, 2, 3)).astype(np.float32))  # [T, NP, NC]
+
+    p_rows = jnp.take(params, cls, axis=-1)  # [T, NP, n]
+    pred, v_ref = eval_tree_batch(trees, X, ops, params=p_rows)
+    l_ref = aggregate_loss(l2_dist_loss, pred, y, v_ref)
+
+    l_fused, v_fused = fused_loss(
+        trees, X, y, None, ops, l2_dist_loss,
+        params=params, class_idx=cls, interpret=True,
+    )
+    assert np.array_equal(np.asarray(v_ref), np.asarray(v_fused))
+    ok = np.isfinite(np.asarray(l_ref))
+    np.testing.assert_allclose(
+        np.asarray(l_ref)[ok], np.asarray(l_fused)[ok], rtol=1e-5)
+
+
+def test_parametric_search_with_turbo_recovers():
+    """Full parametric search on the fused eval path (turbo=True)."""
+    rng = np.random.default_rng(1)
+    n = 240
+    X = rng.uniform(-2, 2, (n, 1)).astype(np.float32)
+    cls = rng.integers(0, 2, n)
+    offsets = np.array([1.0, -2.0], np.float32)
+    y = (2.0 * X[:, 0] + offsets[cls]).astype(np.float32)
+
+    from symbolicregression_jl_tpu import equation_search
+    from symbolicregression_jl_tpu.api.search import RuntimeOptions
+
+    options = Options(
+        binary_operators=["+", "*"], unary_operators=[],
+        maxsize=8, populations=4, population_size=20,
+        ncycles_per_iteration=25,
+        expression_spec=ParametricExpressionSpec(max_parameters=1),
+        turbo=True, save_to_file=False,
+    )
+    hof = equation_search(
+        X, y, options=options, extra={"class": cls},
+        runtime_options=RuntimeOptions(niterations=10, seed=0, verbosity=0),
+    )
+    best = min(hof.pareto_frontier(), key=lambda m: m.loss)
+    assert float(best.loss) < 0.1
