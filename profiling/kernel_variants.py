@@ -1,0 +1,334 @@
+"""A/B kernel-structure variants of the program-loss kernel.
+
+Measures where the per-step scalar-dispatch cost goes by timing
+semantically-degraded or restructured copies of the interpreter:
+
+  base       — the shipped fused_loss_program
+  noswitch   — every step computes binary_op[0] (floor: loop + reads +
+               store + vmask, no dispatch)
+  novmask    — shipped dispatch, but no per-step finiteness tracking
+  cond2      — two-level dispatch: class cond (identity/binary/unary)
+               with an inner per-class switch
+  signmerge  — {+,-} merged into ONE branch via a sign bit packed in the
+               instruction word (val = a + sgn*b, one FMA)
+  nounroll   — no 2x pair unroll
+  tb16/tb32  — tree_block 16/32 (X-copy + grid fixed costs amortized)
+
+Usage: kernel_variants.py [T] [which...]
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from _common import make_bench_problem
+
+from symbolicregression_jl_tpu.ops.fused_eval import (
+    _merged_branches, _pick_tile, _round_up, _unpack, fused_loss_program)
+
+
+def _pack_instr(prog):
+    """Round-3 legacy pack (identity + per-op codes) — the variants here
+    A/B the legacy dispatch layout; the shipped kernels now use the
+    plan-aware pack in ops/fused_eval.py."""
+    return (prog.code << 24) | (prog.src1 << 12) | prog.src2
+from symbolicregression_jl_tpu.ops.program import compile_program
+
+
+def _make_kernel(operators, loss_fn, tree_block, nfeat, cmax, variant):
+    BASE = nfeat + cmax
+    binary_fns = tuple(o.fn for o in operators.binary)
+    unary_fns = tuple(o.fn for o in operators.unary)
+    B = len(binary_fns)
+
+    def kernel(instr_ref, nstep_ref, nconst_ref, cvals_ref, ok_ref,
+               x_ref, y_ref, w_ref, mask_ref, loss_ref, valid_ref, buf_ref):
+        j = pl.program_id(1)
+        y_row = y_ref[0, :]
+        mask_row = mask_ref[0, :] > 0
+        w_row = w_ref[0, :] * mask_ref[0, :]
+        tile = y_row.shape[0]
+        L = instr_ref.shape[-1]
+
+        buf_ref[0:nfeat, :] = x_ref[...]
+        read = lambda i: buf_ref[i, :]
+
+        for t in range(tree_block):
+            bdt = buf_ref.dtype
+
+            def cbody(c, _):
+                buf_ref[nfeat + c, :] = jnp.full(
+                    (tile,), cvals_ref[t, c], dtype=bdt)
+                return 0
+
+            jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
+
+            def step(k, vmask):
+                w_ = instr_ref[t, k]
+                o, i1, i2 = _unpack(w_)
+                if variant == "noswitch":
+                    val = binary_fns[0](read(i1), read(i2))
+                elif variant == "static":
+                    val = binary_fns[0](read(0), read(1))
+                elif variant == "nostore":
+                    val = binary_fns[0](read(i1), read(i2))
+                    buf_ref[BASE, :] = val
+                    return vmask * jnp.isfinite(val).astype(vmask.dtype)
+                elif variant == "cond2":
+                    def class_bin():
+                        return jax.lax.switch(
+                            o - 1, [lambda f=f: f(read(i1), read(i2))
+                                    for f in binary_fns])
+
+                    def class_un():
+                        return jax.lax.switch(
+                            o - 1 - B, [lambda f=f: f(read(i1))
+                                        for f in unary_fns])
+
+                    val = jax.lax.cond(
+                        o == 0, lambda: read(i1),
+                        lambda: jax.lax.cond(o <= B, class_bin, class_un))
+                elif variant in ("signmerge", "combo"):
+                    # codes: 0 id, 1 addsub (sign bit 30), 2 mul, 3 div,
+                    # then unary
+                    s = (w_ >> 30) & 1
+                    o2 = (w_ >> 24) & 0x3F
+                    sgn = (1 - 2 * s).astype(bdt)
+                    branches = [
+                        lambda: read(i1),
+                        lambda: read(i1) + sgn * read(i2),
+                        lambda: binary_fns[2](read(i1), read(i2)),
+                        lambda: binary_fns[3](read(i1), read(i2)),
+                    ] + [lambda f=f: f(read(i1)) for f in unary_fns]
+                    val = jax.lax.switch(o2, branches)
+                else:
+                    val = jax.lax.switch(
+                        o, _merged_branches(operators, read, i1, i2))
+                buf_ref[BASE + k, :] = val
+                if variant == "novmask":
+                    return vmask
+                if val.dtype == jnp.bfloat16:
+                    # Mosaic has no bf16 isfinite (tpu.weird is F32-only);
+                    # bf16 shares f32's exponent range, so a magnitude
+                    # compare is equivalent (NaN compares false).
+                    fin = jnp.abs(val) <= jnp.asarray(3.38e38, val.dtype)
+                    return vmask * fin.astype(vmask.dtype)
+                return vmask * jnp.isfinite(val).astype(vmask.dtype)
+
+            m = nstep_ref[t, 0]
+            vmask0 = jnp.ones((tile,), bdt)
+            if variant in ("nounroll", "combo", "bf16"):
+                vmask = jax.lax.fori_loop(0, m, step, vmask0)
+            else:
+                def pair(k2, vmask):
+                    vmask = step(2 * k2, vmask)
+                    return step(jnp.minimum(2 * k2 + 1, L - 1), vmask)
+
+                vmask = jax.lax.fori_loop(0, (m + 1) >> 1, pair, vmask0)
+            valid = jnp.all((vmask > 0) | jnp.logical_not(mask_row))
+            pred = buf_ref[BASE + m - 1, :].astype(y_row.dtype)
+            elt = loss_fn(pred, y_row)
+            elt = jnp.where(w_row > 0, elt, 0.0)
+            partial = jnp.sum(elt * w_row)
+            partial_ok = jnp.int32(valid & jnp.isfinite(partial)) * ok_ref[t, 0]
+
+            @pl.when(j == 0)
+            def _():
+                loss_ref[t, 0] = partial
+                valid_ref[t, 0] = partial_ok
+
+            @pl.when(j != 0)
+            def _():
+                loss_ref[t, 0] = loss_ref[t, 0] + partial
+                valid_ref[t, 0] = valid_ref[t, 0] & partial_ok
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "nfeatures", "operators", "loss_fn", "tree_block", "variant"))
+def loss_variant(prog, X, y, nfeatures, operators, loss_fn,
+                 tree_block=8, variant="base"):
+    T, L = prog.code.shape
+    CMAX = prog.cmax
+    F, n = X.shape
+    dtype = X.dtype
+    BASE = nfeatures + CMAX
+
+    buf_dtype = jnp.bfloat16 if variant == "bf16" else dtype
+    TB = tree_block
+    bytes_per = jnp.dtype(buf_dtype).itemsize
+    TILE = _pick_tile(n, 16384, BASE + L, bytes_per)
+    T_pad = _round_up(T, TB)
+    n_pad = _round_up(n, TILE)
+
+    def pad_t(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
+                       constant_values=fill)
+
+    instr_w = _pack_instr(prog)
+    if variant in ("signmerge", "combo"):
+        # remap codes: 1:+ 2:- -> code 1 (+ sign bit), 3:* -> 2, 4:/ -> 3,
+        # unary 5.. -> 4..
+        o = prog.code
+        is_sub = o == 2
+        o2 = jnp.where(o <= 2, jnp.minimum(o, 1),
+                       jnp.where(o <= 4, o - 1, o - 1))
+        instr_w = ((is_sub.astype(jnp.int32) << 30) | (o2 << 24)
+                   | (prog.src1 << 12) | prog.src2)
+    instr = pad_t(instr_w)
+    nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
+    nconst = pad_t(prog.nconst.reshape(-1, 1))
+    cvals = pad_t(prog.cvals).astype(dtype)
+    ok = pad_t(prog.const_ok.astype(jnp.int32).reshape(-1, 1), fill=1)
+
+    Xp = jnp.pad(X.astype(buf_dtype), ((0, 0), (0, n_pad - n)))
+    yp = jnp.pad(y.reshape(1, n), ((0, 0), (0, n_pad - n)))
+    w = jnp.ones((1, n), dtype)
+    wp = jnp.pad(w, ((0, 0), (0, n_pad - n)))
+    maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
+
+    grid = (T_pad // TB, n_pad // TILE)
+    kernel = _make_kernel(operators, loss_fn, TB, nfeatures, CMAX, variant)
+
+    smem_i32 = lambda shape: pl.BlockSpec(
+        shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM)
+    row_spec = pl.BlockSpec((1, TILE), lambda i, j: (0, j))
+
+    loss_sum, valid = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            smem_i32((TB, instr.shape[-1])), smem_i32((TB, 1)),
+            smem_i32((TB, 1)),
+            pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            smem_i32((TB, 1)),
+            pl.BlockSpec((F, TILE), lambda i, j: (0, j)),
+            row_spec, row_spec, row_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_pad, 1), dtype),
+            jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((BASE + instr.shape[-1], TILE), buf_dtype)],
+    )(instr, nsteps, nconst, cvals, ok, Xp, yp, wp, maskp)
+    return loss_sum[:T, 0], valid[:T, 0]
+
+
+def synth_program(T, S, L, F, CMAX, n_codes, seed=0):
+    """Random valid TreeProgram with exactly S steps per tree."""
+    from symbolicregression_jl_tpu.ops.program import TreeProgram
+
+    rng = np.random.default_rng(seed)
+    BASE = F + CMAX
+    code = np.zeros((T, L), np.int32)
+    src1 = np.zeros((T, L), np.int32)
+    src2 = np.zeros((T, L), np.int32)
+    code[:, :S] = rng.integers(1, n_codes, (T, S))
+    for k in range(S):
+        hi = BASE + k
+        src1[:, k] = rng.integers(0, hi, T)
+        src2[:, k] = rng.integers(0, hi, T)
+    ncon = np.full((T,), CMAX, np.int32)
+    cvals = rng.uniform(0.5, 1.5, (T, CMAX)).astype(np.float32)
+    cslot = np.tile(np.arange(CMAX, dtype=np.int32), (T, 1))
+    return TreeProgram(
+        code=jnp.asarray(code), src1=jnp.asarray(src1),
+        src2=jnp.asarray(src2),
+        nsteps=jnp.full((T,), S, jnp.int32),
+        cvals=jnp.asarray(cvals), cslot=jnp.asarray(cslot),
+        nconst=jnp.asarray(ncon),
+        const_ok=jnp.ones((T,), bool))
+
+
+def main():
+    T = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    S = int(os.environ.get("STEPS", "8"))
+    which = sys.argv[2:] or ["base", "noswitch", "novmask", "cond2",
+                             "signmerge", "nounroll", "tb16", "tb32"]
+
+    options, ds, engine = make_bench_problem()
+    cfg = engine.cfg
+    X, y = ds.data.Xt, ds.data.y
+    F = X.shape[0]
+    nB = len(cfg.operators.binary)
+
+    n_codes = 1 + len(cfg.operators.binary) + len(cfg.operators.unary)
+    prog = synth_program(T, S, 30, F, 15, n_codes)
+    steps = np.asarray(prog.nsteps)
+    print(f"T={T} steps: mean {steps.mean():.2f} max {steps.max()}")
+
+    base_loss = None
+    for v in which:
+        tb = 8
+        vv = v
+        if v.startswith("tb"):
+            tb = int(v[2:])
+            vv = "base"
+        elif v == "combo":
+            tb = 16
+
+        if v == "prod":
+            @jax.jit
+            def step_fn(p):
+                loss, valid = fused_loss_program(
+                    p, X, y, None, F, cfg.operators,
+                    options.elementwise_loss)
+                eps = jnp.nanmin(
+                    jnp.where(jnp.isfinite(loss), loss, jnp.inf))
+                return dataclasses.replace(
+                    p, cvals=p.cvals + eps * 1e-30), loss
+        else:
+            @jax.jit
+            def step_fn(p, tb=tb, vv=vv):
+                loss, valid = loss_variant(
+                    p, X, y, F, cfg.operators, options.elementwise_loss,
+                    tree_block=tb, variant=vv)
+                eps = jnp.nanmin(
+                    jnp.where(jnp.isfinite(loss), loss, jnp.inf))
+                return dataclasses.replace(
+                    p, cvals=p.cvals + eps * 1e-30), loss
+
+        p2, loss = step_fn(prog)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        N = 30
+        p2 = prog
+        for _ in range(N):
+            p2, loss = step_fn(p2)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / N
+        ok = ""
+        if vv in ("base", "cond2", "signmerge", "nounroll") or v.startswith("tb"):
+            if base_loss is None and v == "base":
+                base_loss = np.asarray(loss)
+            elif base_loss is not None:
+                match = np.allclose(np.asarray(loss), base_loss,
+                                    rtol=1e-6, equal_nan=True)
+                ok = "  loss==base" if match else "  LOSS MISMATCH"
+        print(f"{v:10s} {dt*1e3:8.3f} ms/launch  {T/dt:>10.0f} trees/s"
+              f"  {dt/T/steps.mean()*1e9:6.1f} ns/step{ok}")
+
+
+if __name__ == "__main__":
+    main()

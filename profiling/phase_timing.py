@@ -1,0 +1,70 @@
+"""Honest wall-clock phase split of a bench-config iteration.
+
+Times three engine configs on the real chip (dependency-chained
+iterations, compile excluded):
+  A: full bench config            -> t_full
+  B: A minus constant optimizer   -> t_noopt   (optimizer = A - B)
+  C: B at ncycles=10              -> per-cycle = (B - C) / 90,
+                                     fixed epilogue = C - 10*per_cycle
+
+Usage: phase_timing.py [islands] [pop] [ncycles]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from _common import make_bench_problem
+
+
+def time_config(I, P, NC, iters=2, **kw):
+    from symbolicregression_jl_tpu import search_key
+
+    options, ds, engine = make_bench_problem(
+        populations=I, population_size=P, ncycles_per_iteration=NC,
+        tournament_selection_n=16, **kw)
+    state = engine.init_state(search_key(0), ds.data, I)
+    state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    e0 = float(state.num_evals)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    dt = (time.perf_counter() - t0) / iters
+    ev = (float(state.num_evals) - e0) / iters
+    return dt, ev
+
+
+def main():
+    I = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    P = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    NC = int(sys.argv[3]) if len(sys.argv) > 3 else 100
+
+    tA, evA = time_config(I, P, NC)
+    print(f"A full:      {tA:7.3f} s/iter  {evA:12.0f} evals  "
+          f"{evA/tA:10.0f} ev/s")
+    tB, evB = time_config(I, P, NC, should_optimize_constants=False)
+    print(f"B no-opt:    {tB:7.3f} s/iter  {evB:12.0f} evals  "
+          f"{evB/tB:10.0f} ev/s")
+    tC, evC = time_config(I, P, 10, should_optimize_constants=False)
+    print(f"C no-opt/10c:{tC:7.3f} s/iter  {evC:12.0f} evals")
+    per_cycle = (tB - tC) / (NC - 10)
+    fixed = tC - 10 * per_cycle
+    print(f"optimizer phase:   {tA - tB:7.3f} s/iter "
+          f"({evA - evB:12.0f} evals -> {(evA-evB)/max(tA-tB,1e-9):10.0f} ev/s)")
+    print(f"evolve cycles:     {per_cycle*1e3:7.2f} ms/cycle x {NC} "
+          f"= {per_cycle*NC:7.3f} s/iter "
+          f"({evB - evC:12.0f} evals over {NC-10} cycles -> "
+          f"{(evB-evC)/((NC-10)*per_cycle):10.0f} ev/s)")
+    print(f"fixed epilogue:    {fixed:7.3f} s/iter")
+
+
+if __name__ == "__main__":
+    main()

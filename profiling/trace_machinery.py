@@ -1,0 +1,75 @@
+"""Per-fusion breakdown of the evolve-cycle machinery.
+
+Runs a no-optimizer iteration (the cycle scan dominates it) under the
+profiler and aggregates device events by EXACT op name, printing each
+top op's long_name snippet — fine-grained enough to attribute the
+mutation/selection machinery, unlike trace_cycle's prefix buckets.
+
+Usage: trace_machinery.py [islands] [ncycles] [pop]
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from _common import make_bench_problem
+
+
+def main():
+    I = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    NC = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    P = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+
+    from symbolicregression_jl_tpu import search_key
+
+    options, ds, engine = make_bench_problem(
+        populations=I, population_size=P, ncycles_per_iteration=NC,
+        tournament_selection_n=16, should_optimize_constants=False,
+    )
+    state = engine.init_state(search_key(0), ds.data, I)
+    state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+
+    logdir = "/tmp/sr_trace_m"
+    os.system(f"rm -rf {logdir}")
+    with jax.profiler.trace(logdir):
+        state = engine.run_iteration(state, ds.data, options.maxsize)
+        jax.block_until_ready(state.pops.cost)
+
+    files = glob.glob(f"{logdir}/**/*.trace.json.gz", recursive=True)
+    agg = defaultdict(float)
+    names = {}
+    total = 0.0
+    for fn in files:
+        with gzip.open(fn, "rt") as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            name = ev.get("name", "")
+            args = ev.get("args", {})
+            if "long_name" not in args:
+                continue
+            dur = ev.get("dur", 0) / 1e3
+            if name.startswith("while"):
+                continue  # scan wrappers double-count their bodies
+            agg[name] += dur
+            names[name] = args.get("long_name", "")[:160]
+            total += dur
+    print(f"total attributed device op time: {total:.1f} ms over {NC} cycles"
+          f" ({total/NC:.2f} ms/cycle incl. epilogue)")
+    for k, v in sorted(agg.items(), key=lambda kv: -kv[1])[:30]:
+        print(f"  {v:9.3f} ms  {k:28s} {names[k]}")
+
+
+if __name__ == "__main__":
+    main()

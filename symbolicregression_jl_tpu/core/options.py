@@ -293,6 +293,15 @@ class Options:
         optimizer_iterations: Optional[int] = None,
         optimizer_f_calls_limit: Optional[int] = None,
         should_optimize_constants: bool = True,
+        # bfloat16 line-search evals on the fused TPU path (step-size
+        # selection only; accepted points re-verified at f32). Doubles
+        # the variants-per-dispatch of the optimizer's dominant kernel,
+        # but every step pays a bf16<->f32 relayout on v5e (bf16 (16,128)
+        # vs f32 (8,128) tiling), which measured as a NET loss on the
+        # bench — off by default; the f32 single-chunk line search
+        # (fused_loss_multi's chunk planner) captures the dispatch
+        # amortization without the conversions.
+        optimizer_bf16_linesearch: bool = False,
         # 8. Migration
         migration: bool = True,
         hof_migration: bool = True,
@@ -436,6 +445,7 @@ class Options:
 
         self.optimizer_algorithm = optimizer_algorithm
         self.optimizer_nrestarts = int(optimizer_nrestarts)
+        self.optimizer_bf16_linesearch = bool(optimizer_bf16_linesearch)
         self.optimizer_probability = float(optimizer_probability)
         self.optimizer_iterations = int(
             optimizer_iterations if optimizer_iterations is not None else 8

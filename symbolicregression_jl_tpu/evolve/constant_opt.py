@@ -35,6 +35,11 @@ class OptimizerConfig(NamedTuple):
     max_linesearch: int = 8
     c1: float = 1e-4             # Armijo condition coefficient
     shrink: float = 0.5
+    # bfloat16 line-search evals (fused path only): doubles the
+    # variants-per-dispatch of the dominant kernel; candidate losses
+    # only pick the step size, and the accepted point is re-verified at
+    # f32 (descent guard in `optimize_constants_fused`).
+    ls_bf16: bool = False
 
 
 def _bfgs_minimize(f, x0, mask, cfg: OptimizerConfig):
@@ -178,7 +183,7 @@ def optimize_constants_fused(
         cv = cand_x.reshape(P, R * C, CM)
         loss, _ = fused_loss_multi(
             prog, cv, X, y, w, F, operators, elementwise_loss,
-            interpret=interpret)
+            bf16=cfg.ls_bf16, interpret=interpret)
         return loss.reshape(P * R, C)
 
     fx0, g0 = vg(x)
@@ -235,6 +240,13 @@ def optimize_constants_fused(
         s = t_star[:, None] * d
         x_new = x + s
         f_new, g_new = vg(x_new)
+        # Descent guard at f32: with an exact line search Armijo already
+        # implies f_new < fx, but bf16 candidate losses (~3 significant
+        # digits) can accept a step that is uphill at full precision —
+        # reject it here using the f32 loss the gradient kernel just
+        # computed anyway.
+        any_ok = any_ok & (f_new <= fx)
+        s = jnp.where(any_ok[:, None], s, 0.0)
         x_new = jnp.where(any_ok[:, None], x_new, x)
         f_new = jnp.where(any_ok, f_new, fx)
         g_new = jnp.where(any_ok[:, None], g_new, g)

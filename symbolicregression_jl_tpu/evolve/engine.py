@@ -18,6 +18,7 @@ Lineage ref rotation mirrors src/SingleIteration.jl:99-137.
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 from typing import NamedTuple, Optional, Sequence, Tuple
 
@@ -40,6 +41,7 @@ from .simplify import fold_constants_batch
 from .step import (
     EvolveConfig,
     HofState,
+    _member_take_onehot,
     empty_hof,
     eval_cost_batch,
     evolve_config_from_options,
@@ -107,6 +109,11 @@ class Engine:
         self.opt_cfg = OptimizerConfig(
             iterations=options.optimizer_iterations,
             nrestarts=options.optimizer_nrestarts,
+            # bf16 step-size selection only on the real-TPU fused path
+            # (interpret-mode runs keep f32 so CPU tests match the
+            # reference semantics bit-for-bit).
+            ls_bf16=(options.optimizer_bf16_linesearch
+                     and self.cfg.turbo and not self.cfg.interpret),
         )
         self.window_size = float(window_size)
         self._iteration = jax.jit(self._iteration_impl, donate_argnums=(0,))
@@ -375,18 +382,13 @@ class Engine:
             # (simplify_tree! maps over the inner expressions,
             # /root/reference/src/TemplateExpression.jl:881-891).
             K = cfg.template.n_subexpressions
-            fold_nfeat = max(self.nfeatures, *cfg.template.num_features, 1)
 
             def fold(trees):  # [I, P, K, L]
                 flat = trees.reshape(I, P * K)
-                out = jax.vmap(
-                    lambda t: fold_constants_batch(t, fold_nfeat, cfg.operators)
-                )(flat)
+                out = fold_constants_batch(flat, cfg.operators)
                 return out.reshape(I, P, K)
         else:
-            fold = jax.vmap(
-                lambda t: fold_constants_batch(t, self.nfeatures, cfg.operators)
-            )
+            fold = lambda t: fold_constants_batch(t, cfg.operators)
         if cfg.should_simplify:
             pops = dataclasses.replace(pops, trees=fold(pops.trees))
         elif float(options.mutation_weights.simplify) > 0:
@@ -560,7 +562,10 @@ class Engine:
             # sharded island axis XLA turns this reshape into an all_gather.
             topn = min(options.topn, P)
             order = jnp.argsort(pops.cost, axis=1)[:, :topn]  # [I, topn]
-            pool = jax.vmap(lambda p, o: p.member(o))(pops, order)
+            # Batched one-hot row-takes (MXU): the vmapped jnp.take per
+            # field serialized into per-iteration kCustom gathers.
+            pool = jax.vmap(lambda p, o: _member_take_onehot(p, o, P))(
+                pops, order)
             pool = jax.tree.map(
                 lambda x: x.reshape((I * topn,) + x.shape[2:]), pool
             )
@@ -608,7 +613,15 @@ def _migrate(key, pops: PopulationState, pool: PopulationState, frac: float,
              birth, I: int, P: int, candidate_mask=None):
     """Replace each member with a random pool candidate w.p. `frac`
     (binomial-per-member equivalent of the reference's Poisson count with
-    random positions, src/Migration.jl:20-35); birth reset to fresh ticks."""
+    random positions, src/Migration.jl:20-35); birth reset to fresh ticks.
+
+    Only ~frac of members actually migrate, so pool rows are gathered
+    for a binomial-mean + 3-sigma PACK of replaced slots and scattered
+    back — gathering a candidate for every slot serialized into ~370 ms
+    of kCustom gathers per iteration at the bench config. Slots past the
+    pack bound (beyond ~3 sigma, vanishingly rare) skip migration this
+    iteration, mirroring the crossover cand2 pack's overflow rule.
+    """
     if frac <= 0:
         return pops, birth
     k1, k2 = jax.random.split(key)
@@ -622,30 +635,46 @@ def _migrate(key, pops: PopulationState, pool: PopulationState, frac: float,
     else:
         pick = jax.random.randint(k2, (I, P), 0, n_pool)
 
-    picked = pool.member(pick.reshape(-1))
-    picked = jax.tree.map(
-        lambda x: x.reshape((I, P) + x.shape[1:]), picked
-    )
+    N = I * P
+    f = min(float(frac), 1.0)
+    kpack = min(N, int(math.ceil(
+        N * f + 3.0 * math.sqrt(N * f * (1.0 - f)) + 1.0)))
+    flat_replace = replace.reshape(N)
+    flat_pick = pick.reshape(N)
+    rank = jnp.cumsum(flat_replace.astype(jnp.int32)) - 1
+    overflow = flat_replace & (rank >= kpack)
+    flat_replace = flat_replace & ~overflow
+    replace = flat_replace.reshape(I, P)
 
-    def sel(new, old):
-        shape = replace.shape + (1,) * (new.ndim - 2)
-        return jnp.where(replace.reshape(shape), new, old)
+    # pack positions: top_k is stable, so the first kpack replaced slots
+    # come out in slot order; unreplaced filler rows are dropped at the
+    # scatter via an out-of-range target.
+    _, pos = jax.lax.top_k(flat_replace.astype(jnp.float32), kpack)
+    row_live = jnp.take(flat_replace, pos)
+    target = jnp.where(row_live, pos, N)
+
+    picked = pool.member(jnp.take(flat_pick, pos))  # [kpack, ...] gathers
+
+    def scat2(old_field, new_field):
+        flat = old_field.reshape((N,) + old_field.shape[2:])
+        out = flat.at[target].set(new_field, mode="drop")
+        return out.reshape(old_field.shape)
 
     new_birth_ticks = birth[:, None] + jnp.arange(P, dtype=jnp.int32)[None, :]
     out = PopulationState(
         trees=TreeBatch(
-            arity=sel(picked.trees.arity, pops.trees.arity),
-            op=sel(picked.trees.op, pops.trees.op),
-            feat=sel(picked.trees.feat, pops.trees.feat),
-            const=sel(picked.trees.const, pops.trees.const),
-            length=sel(picked.trees.length, pops.trees.length),
+            arity=scat2(pops.trees.arity, picked.trees.arity),
+            op=scat2(pops.trees.op, picked.trees.op),
+            feat=scat2(pops.trees.feat, picked.trees.feat),
+            const=scat2(pops.trees.const, picked.trees.const),
+            length=scat2(pops.trees.length, picked.trees.length),
         ),
-        cost=sel(picked.cost, pops.cost),
-        loss=sel(picked.loss, pops.loss),
-        complexity=sel(picked.complexity, pops.complexity),
+        cost=scat2(pops.cost, picked.cost),
+        loss=scat2(pops.loss, picked.loss),
+        complexity=scat2(pops.complexity, picked.complexity),
         birth=jnp.where(replace, new_birth_ticks, pops.birth),
-        ref=sel(picked.ref, pops.ref),
-        parent=sel(picked.parent, pops.parent),
-        params=sel(picked.params, pops.params),
+        ref=scat2(pops.ref, picked.ref),
+        parent=scat2(pops.parent, picked.parent),
+        params=scat2(pops.params, picked.params),
     )
     return out, birth + P

@@ -1,12 +1,26 @@
 """Device-side constant folding (simplify_tree! analogue).
 
-Collapses maximal all-constant subtrees into single constant leaves using
-one interpreter pass on a single dummy row plus a compaction gather — the
+Collapses maximal all-constant subtrees into single constant leaves, the
 tensor equivalent of DynamicExpressions' `simplify_tree!` as invoked once
 per iteration in optimize_and_simplify_population
 (/root/reference/src/SingleIteration.jl:79-85). The algebraic
 `combine_operators` rewrites remain host-side (ops.tree.combine_operators)
 and run outside the hot path.
+
+Everything here is BATCH-vectorized — no per-member dynamic indexing.
+The original implementation vmapped a per-tree routine whose two
+`lax.scan`s read stack/buffer slots via `dynamic_index_in_dim`; under
+vmap those lower to XLA's serialized kCustom gathers, which cost ~370 ms
+per iteration on the whole-population fold at the bench config (eight
+23 ms gather fusions — one per unroll segment). The rewrite:
+
+- const-subtree detection in closed form: a subtree is all-constant iff
+  its span contains no VAR/PARAM leaf — one prefix sum plus a
+  `lane_take` of the span starts (no stack walk);
+- node values from an unrolled L-step loop over a [members, L] value
+  buffer: child reads are `lane_take` one-hot contractions, the operator
+  is selected by a where-chain over the (small) op tables, and the
+  buffer update is a masked select — all wide VPU ops.
 """
 
 from __future__ import annotations
@@ -16,123 +30,102 @@ import jax.numpy as jnp
 
 from ..ops.encoding import (
     LEAF_CONST,
-    MAX_ARITY,
     TreeBatch,
-    _tree_structure_single,
+    _structure_from_arity,
     lane_take,
 )
-from ..ops.eval import eval_single_tree
 
 __all__ = ["fold_constants_batch"]
 
 
-def _fold_single(tree: TreeBatch, X1, operators):
-    """Fold one tree. X1 is a [F, 1] dummy input."""
-    L = tree.arity.shape[0]
-    child, size, _ = _tree_structure_single(tree.arity, tree.length)
-    slot = jnp.arange(L)
-    in_tree = slot < tree.length
+def _select_op_lanes(fns, o, *args):
+    """where-chain select over a small op table (no dynamic_index_in_dim,
+    which serializes per member under vmap/batching)."""
+    out = fns[0](*args)
+    for j in range(1, len(fns)):
+        out = jnp.where(o == j, fns[j](*args), out)
+    return out
 
-    # is_const_subtree via one postfix stack scan.
-    def step(carry, k):
-        stack, sp = carry
-        a = tree.arity[k]
-        all_const = jnp.bool_(True)
-        for j in range(MAX_ARITY):
-            pos = sp - a + j
-            is_child = j < a
-            all_const = all_const & (
-                ~is_child | stack[jnp.maximum(pos, 0)]
-            )
-        leaf_const = tree.op[k] == LEAF_CONST
-        c_k = jnp.where(a == 0, leaf_const, all_const)
-        new_sp = sp - a + 1
-        stack = stack.at[new_sp - 1].set(c_k)
-        return (stack, new_sp), c_k
 
-    # unroll=4 (not full): a fully-unrolled scan fuses into one kLoop
-    # whose live set exceeds XLA's scoped-VMEM budget when vmapped over
-    # whole populations.
-    (_, _), is_const = jax.lax.scan(
-        step, (jnp.zeros((L,), jnp.bool_), jnp.int32(0)),
-        jnp.arange(L, dtype=jnp.int32), unroll=4,
+def fold_constants_batch(trees: TreeBatch, operators) -> TreeBatch:
+    """Fold constants for a [P, L] batch of trees (any leading dims).
+
+    Leaf values of non-const subtrees are never consumed, so no feature
+    data is needed — VAR/PARAM leaves evaluate as 0 into dead lanes."""
+    arity, op, feat, const, length = (
+        trees.arity, trees.op, trees.feat, trees.const, trees.length)
+    L = arity.shape[-1]
+    slot = jnp.arange(L, dtype=jnp.int32)
+    in_tree = slot < length[..., None]
+
+    child, size, _ = _structure_from_arity(arity, need_depth=False)
+    start = (slot - size + 1).astype(jnp.int32)
+
+    # is_const[k]: no VAR/PARAM leaf inside span [start(k), k].
+    bad = (in_tree & (arity == 0) & (op != LEAF_CONST)).astype(jnp.int32)
+    badc = jnp.cumsum(bad, axis=-1)                      # inclusive
+    before = jnp.where(
+        start > 0,
+        lane_take(badc, jnp.maximum(start - 1, 0)),
+        0,
     )
+    is_const = (badc - before == 0) & in_tree
 
-    # Node values on the dummy row: const-subtree values are X-independent.
-    # We need the full buffer, so inline a tiny interpreter via the spans:
-    # reuse eval by evaluating each prefix? Cheaper: evaluate once and read
-    # the buffer — replicate eval_single_tree's scan but keep buf.
-    from ..ops.eval import _apply_tables
-    from ..ops.encoding import LEAF_PARAM
-
-    def eval_step(carry, k):
-        buf, = carry
-        a = tree.arity[k]
-        o = tree.op[k]
-        children = [
-            jax.lax.dynamic_index_in_dim(buf, child[k, j], axis=0, keepdims=False)
-            for j in range(MAX_ARITY)
-        ]
-        x_row = jax.lax.dynamic_index_in_dim(X1, tree.feat[k], axis=0, keepdims=False)
-        leaf = jnp.where(o == LEAF_CONST, jnp.broadcast_to(tree.const[k], (1,)), x_row)
-        leaf = jnp.where((a == 0) & (o == LEAF_PARAM), jnp.nan, leaf)
-        val = _apply_tables(operators, a, o, leaf, children).astype(tree.const.dtype)
-        buf = buf.at[k].set(val)
-        return (buf,), None
-
-    (buf,), _ = jax.lax.scan(
-        eval_step, (jnp.zeros((L, 1), tree.const.dtype),),
-        jnp.arange(L, dtype=jnp.int32), unroll=4,
-    )
-    values = buf[:, 0]
+    # Node values over a [.., L] buffer, one unrolled step per slot:
+    # only const-subtree values are consumed, so VAR/PARAM leaves read 0.
+    unary_fns = tuple(o_.fn for o_ in operators.unary)
+    binary_fns = tuple(o_.fn for o_ in operators.binary)
+    leaf_val = jnp.where((arity == 0) & (op == LEAF_CONST), const, 0.0)
+    buf = jnp.zeros(arity.shape, const.dtype)
+    for k in range(L):
+        a = arity[..., k]
+        o = op[..., k]
+        ch = lane_take(buf, child[..., k, :])            # [..., 2]
+        val = leaf_val[..., k]
+        if unary_fns:
+            un = _select_op_lanes(unary_fns, o, ch[..., 0])
+            val = jnp.where(a == 1, un, val)
+        if binary_fns:
+            bi = _select_op_lanes(binary_fns, o, ch[..., 0], ch[..., 1])
+            val = jnp.where(a == 2, bi, val)
+        buf = jnp.where(slot == k, val[..., None].astype(const.dtype), buf)
+    values = buf
 
     # A node is *inside* a folded subtree iff some LATER const node's
     # span contains it (postfix: ancestors come after descendants, and
     # const-ness is subtree-contiguous, so "parent is const" ⟺ "covered
     # by any const node's strict span"). covered[c] = ∃ k > c with
     # is_const[k] and start_k <= c — an O(L) exclusive suffix-min of the
-    # const spans' starts (no parent pointers, no [L, L] intermediates,
-    # which blew XLA's scoped-VMEM budget when vmapped over whole
-    # populations).
+    # const spans' starts.
     BIG = jnp.int32(L + 1)
-    start = (slot - size + 1).astype(jnp.int32)
     vals = jnp.where(is_const & in_tree, start, BIG)
-    # exclusive suffix-min by doubling shifts (log L slice+min passes —
-    # keeps the lowering to plain vector ops)
-    m_excl = jnp.concatenate([vals[1:], jnp.full((1,), BIG)])
+    pad = jnp.full(vals.shape[:-1] + (1,), BIG)
+    m_excl = jnp.concatenate([vals[..., 1:], pad], axis=-1)
     sh = 1
     while sh < L:
-        m_excl = jnp.minimum(
-            m_excl,
-            jnp.concatenate([m_excl[sh:], jnp.full((sh,), BIG)]),
-        )
+        shifted = jnp.concatenate(
+            [m_excl[..., sh:],
+             jnp.broadcast_to(BIG, m_excl.shape[:-1] + (sh,))], axis=-1)
+        m_excl = jnp.minimum(m_excl, shifted)
         sh *= 2
     parent_is_const = m_excl <= slot
     is_fold_root = is_const & ~parent_is_const & in_tree
     keep = in_tree & (~is_const | is_fold_root)
 
-    # Compact: gather kept slots in order.
-    new_len = jnp.sum(keep.astype(jnp.int32))
-    order_key = jnp.where(keep, slot, L + slot)  # kept first, stable
-    perm = jnp.argsort(order_key)
+    # Compact: gather kept slots in order (lane_take one-hot sums).
+    new_len = jnp.sum(keep.astype(jnp.int32), axis=-1)
+    order_key = jnp.where(keep, slot, L + slot)          # kept first, stable
+    perm = jnp.argsort(order_key, axis=-1)
     g = lambda x: lane_take(x, perm)
-    folded_to_leaf = is_fold_root & (tree.arity > 0)
-    arity = jnp.where(folded_to_leaf, 0, tree.arity)
-    op = jnp.where(folded_to_leaf, LEAF_CONST, tree.op)
-    const = jnp.where(is_fold_root, values, tree.const)
-    out_mask = slot < new_len
+    folded_to_leaf = is_fold_root & (arity > 0)
+    arity2 = jnp.where(folded_to_leaf, 0, arity)
+    op2 = jnp.where(folded_to_leaf, LEAF_CONST, op)
+    const2 = jnp.where(is_fold_root, values, const)
+    out_mask = slot < new_len[..., None]
     return TreeBatch(
-        arity=jnp.where(out_mask, g(arity), 0),
-        op=jnp.where(out_mask, g(op), 0),
-        feat=jnp.where(out_mask, g(tree.feat), 0),
-        const=jnp.where(out_mask, g(const), 0.0),
+        arity=jnp.where(out_mask, g(arity2), 0),
+        op=jnp.where(out_mask, g(op2), 0),
+        feat=jnp.where(out_mask, g(feat), 0),
+        const=jnp.where(out_mask, g(const2), 0.0),
         length=new_len,
     )
-
-
-def fold_constants_batch(trees: TreeBatch, nfeatures: int, operators) -> TreeBatch:
-    """Fold constants for a [P, L] batch of trees."""
-    X1 = jnp.zeros((nfeatures, 1), trees.const.dtype)
-    return jax.vmap(lambda a, o, f, c, ln: _fold_single(
-        TreeBatch(a, o, f, c, ln), X1, operators
-    ))(trees.arity, trees.op, trees.feat, trees.const, trees.length)

@@ -827,9 +827,29 @@ def generation_step(
         immediate, jnp.bool_(True),
         jnp.where(accepted_mut, True, ~jnp.bool_(cfg.skip_mutation_failures)),
     )
-    m1_params = pop.member(i1).params
+    # m1_all was gathered via the one-hot matmul above — a fresh
+    # pop.member(i1) here re-gathers every tree field through XLA's
+    # serialized kCustom lowering (~5 ms/cycle at the bench config).
+    # The one-hot float gather CLAMPS non-finite constants (see
+    # _onehot_rows_f); a kept-parent fallback would otherwise write the
+    # clamped genome back into the population, so slots whose parent
+    # carried non-finite constants/params get a NaN planted in slot 0 —
+    # the stored member stays invalid-on-eval exactly like its parent
+    # (whose cost, carried below, is already inf).
+    m1_params = m1_all.params
+    badflag = ~jnp.all(
+        jnp.isfinite(pop.trees.const.reshape(P, -1)), axis=1
+    ) | ~jnp.all(jnp.isfinite(pop.params.reshape(P, -1)), axis=1)
+    slot_bad1 = jnp.take(badflag, i1)                       # [B]
+    fb_trees = m1_all.trees
+    nan_mark = slot_bad1[:, None] & (
+        jnp.arange(fb_trees.const.shape[-1]) == 0)
+    if fb_trees.const.ndim == 3:                            # template [B,K,L]
+        nan_mark = nan_mark[:, None, :]
+    fb_trees = dataclasses.replace(
+        fb_trees, const=jnp.where(nan_mark, jnp.nan, fb_trees.const))
     accept1 = accepted_mut & ~immediate
-    baby1_tree = M._select_tree(accept1, cand1, pop.member(i1).trees)
+    baby1_tree = M._select_tree(accept1, cand1, fb_trees)
     baby1_params = jnp.where(
         accept1.reshape(accept1.shape + (1, 1)), cand1_params, m1_params
     )

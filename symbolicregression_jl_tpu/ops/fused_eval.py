@@ -28,7 +28,7 @@ Kernel families (all sharing the program interpreter):
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -87,7 +87,7 @@ def supports_fused_eval(operators: OperatorSet) -> bool:
 
 
 def _merged_branches(operators: OperatorSet, read, i1, i2):
-    """Branch list for the merged opcode switch at one program step.
+    """Branch list for the legacy opcode switch at one program step.
 
     Order matches ops/program.py's code assignment: 0 = identity (for
     leaf-only trees), then binary ops (the most frequent class — the
@@ -108,24 +108,246 @@ def _unpack(w):
     return w >> 24, (w >> 12) & 0xFFF, w & 0xFFF
 
 
-def _pack_instr(prog: TreeProgram) -> jax.Array:
-    """[T, L] int32 instruction words (op << 24 | src1 << 12 | src2)."""
-    return (prog.code << 24) | (prog.src1 << 12) | prog.src2
+class _DispatchPlan(NamedTuple):
+    """Branch layout of the in-kernel opcode switch.
+
+    Each switch branch costs real scalar-core time per step whether or
+    not it's taken (measured ~1 ms per branch per 65k steps at 10k rows
+    — profiling/kernel_variants.py), so the plan trims the branch list
+    where operator algebra allows:
+
+    - ``merged``: '+' is present, so the identity branch (used only by
+      leaf-only trees / pad rows) is eliminated by rewriting `copy(a)`
+      as `a + ZERO` against a guaranteed-zero buffer row, and — when '-'
+      is present too — `a - b` rides the SAME branch as `a + b` via a
+      sign bit in the instruction word (`a + sgn*b`, one FMA).
+    - non-merged (no '+'): the legacy layout (identity, binaries,
+      unaries) is kept unchanged.
+
+    Packed word, merged: sign << 30 | code << 24 | src1 << 12 | src2
+    (codes 6-bit; bit 31 stays clear for the arithmetic unpack shift).
+    """
+
+    merged: bool
+    has_sub: bool
+    n_branches: int                 # total switch branches
+    nb_class: int                   # branches in the binary class
+    other_bin: Tuple[int, ...]      # operators.binary indices, new-code order
+    old2new: Tuple[int, ...]        # legacy code -> new code
+    sign_old: Tuple[int, ...]       # legacy code -> sign bit
+
+
+@functools.lru_cache(maxsize=None)
+def _dispatch_plan(operators: OperatorSet) -> _DispatchPlan:
+    names = [op.name for op in operators.binary]
+    B, U = len(operators.binary), len(operators.unary)
+    n_old = 1 + B + U
+    if "+" not in names:
+        return _DispatchPlan(False, False, n_old, 1 + B,
+                             tuple(range(B)), tuple(range(n_old)),
+                             (0,) * n_old)
+    add_i = names.index("+")
+    sub_i = names.index("-") if "-" in names else None
+    old2new = [0] * n_old
+    sign_old = [0] * n_old
+    other = []
+    nxt = 1
+    for j in range(B):
+        if j == add_i:
+            old2new[1 + j] = 0
+        elif sub_i is not None and j == sub_i:
+            old2new[1 + j] = 0
+            sign_old[1 + j] = 1
+        else:
+            old2new[1 + j] = nxt
+            other.append(j)
+            nxt += 1
+    nb_class = nxt
+    for u in range(U):
+        old2new[1 + B + u] = nb_class + u
+    return _DispatchPlan(True, sub_i is not None, nb_class + U, nb_class,
+                         tuple(other), tuple(old2new), tuple(sign_old))
+
+
+def _pack_instr(prog: TreeProgram, operators: OperatorSet,
+                zero_addr: int) -> jax.Array:
+    """[T, L] int32 instruction words for the plan's dispatch layout."""
+    plan = _dispatch_plan(operators)
+    if not plan.merged:
+        return (prog.code << 24) | (prog.src1 << 12) | prog.src2
+    # Where-chain remap, NOT jnp.take: an XLA gather over [T, L] lanes
+    # serializes on TPU (~20 ms per pack at the bench shapes — measured
+    # as a net bench regression before this was switched).
+    code = prog.code
+    new_code = jnp.zeros_like(code)
+    for old, nc in enumerate(plan.old2new):
+        if nc != 0:
+            new_code = jnp.where(code == old, jnp.int32(nc), new_code)
+    sign = jnp.zeros_like(code)
+    for old, sg in enumerate(plan.sign_old):
+        if sg:
+            sign = jnp.where(code == old, jnp.int32(1), sign)
+    # identity (legacy code 0, leaf-only trees and pad rows) becomes
+    # `src1 + ZERO`; the kernels keep a zeroed row at ``zero_addr``.
+    src2 = jnp.where(code == 0, jnp.int32(zero_addr), prog.src2)
+    return (sign << 30) | (new_code << 24) | (prog.src1 << 12) | src2
+
+
+def _fwd_dispatch(operators: OperatorSet, read, w, dtype):
+    """One program step's value: unpack ``w``, dispatch the merged opcode.
+
+    With a bfloat16 value buffer the step COMPUTES in f32 (Mosaic's
+    transcendentals and comparisons are f32-only, and VPU arithmetic
+    runs at f32 width anyway) — operands upcast on read and the f32
+    result is returned; the caller downcasts at the buffer store, so
+    only the VMEM residency is halved.
+    """
+    compute32 = dtype == jnp.bfloat16
+    if compute32:
+        rd = lambda i: read(i).astype(jnp.float32)
+        cdt = jnp.float32
+    else:
+        rd = read
+        cdt = dtype
+    plan = _dispatch_plan(operators)
+    if not plan.merged:
+        o, i1, i2 = _unpack(w)
+        return jax.lax.switch(o, _merged_branches(operators, rd, i1, i2))
+    o = (w >> 24) & 0x3F
+    i1 = (w >> 12) & 0xFFF
+    i2 = w & 0xFFF
+    if plan.has_sub:
+        s = (w >> 30) & 1
+        addsub = lambda: rd(i1) + (1 - 2 * s).astype(cdt) * rd(i2)
+    else:
+        addsub = lambda: rd(i1) + rd(i2)
+    branches = [addsub]
+    for j in plan.other_bin:
+        branches.append(lambda f=operators.binary[j].fn: f(rd(i1), rd(i2)))
+    for op in operators.unary:
+        branches.append(lambda f=op.fn: f(rd(i1)))
+    return jax.lax.switch(o, branches)
+
+
+def _bwd_dispatch(operators: OperatorSet, read, w, ct, mask_row,
+                  store1, store2):
+    """Adjoint of one program step: cotangents for its operand(s).
+
+    ``store1(addr, val)`` / ``store2(addr, val)`` write the operand
+    cotangents (the two backward kernels differ in store semantics —
+    plain vs X-region-accumulating). Padded rows carry zero cotangents
+    but arbitrary operand values, so vjps can produce 0/0 = NaN there;
+    values are masked with ``mask_row`` before storing (one NaN would
+    poison the gradient sums).
+    """
+    plan = _dispatch_plan(operators)
+    binary_fns = tuple(op.fn for op in operators.binary)
+    unary_fns = tuple(op.fn for op in operators.unary)
+    mask01 = lambda v: jnp.where(mask_row, v, 0.0)
+
+    if not plan.merged:
+        o, i1, i2 = _unpack(w)
+        B = len(binary_fns)
+
+        @pl.when(o == 0)
+        def _():
+            store1(i1, ct)
+
+        if binary_fns:
+            @pl.when((o >= 1) & (o <= B))
+            def _():
+                x1 = read(i1)
+                x2 = read(i2)
+                if len(binary_fns) == 1:
+                    db1, db2 = _vjp_binary(binary_fns[0], x1, x2, ct)
+                else:
+                    db1, db2 = jax.lax.switch(
+                        o - 1,
+                        [lambda xx, yy, cc, f=f: _vjp_binary(f, xx, yy, cc)
+                         for f in binary_fns], x1, x2, ct)
+                store1(i1, mask01(db1))
+                store2(i2, mask01(db2))
+
+        if unary_fns:
+            @pl.when(o > B)
+            def _():
+                x1 = read(i1)
+                if len(unary_fns) == 1:
+                    du = _vjp_unary(unary_fns[0], x1, ct)
+                else:
+                    du = jax.lax.switch(
+                        o - 1 - B,
+                        [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
+                         for f in unary_fns], x1, ct)
+                store1(i1, mask01(du))
+        return
+
+    o = (w >> 24) & 0x3F
+    i1 = (w >> 12) & 0xFFF
+    i2 = w & 0xFFF
+    s = (w >> 30) & 1
+    NBc = plan.nb_class
+
+    @pl.when(o < NBc)
+    def _():
+        x1 = read(i1)
+        x2 = read(i2)
+
+        def addsub_vjp(xx, yy, cc):
+            # d(a + sgn*b) = (ct, sgn*ct); identity rows (b = ZERO) send
+            # sgn*ct into the zero row's adjoint, which is never read.
+            del xx, yy
+            if plan.has_sub:
+                return cc, (1 - 2 * s).astype(cc.dtype) * cc
+            return cc, cc
+
+        fns = [addsub_vjp] + [
+            lambda xx, yy, cc, f=binary_fns[j]: _vjp_binary(f, xx, yy, cc)
+            for j in plan.other_bin]
+        if len(fns) == 1:
+            db1, db2 = fns[0](x1, x2, ct)
+        else:
+            db1, db2 = jax.lax.switch(o, fns, x1, x2, ct)
+        store1(i1, mask01(db1))
+        store2(i2, mask01(db2))
+
+    if unary_fns:
+        @pl.when(o >= NBc)
+        def _():
+            x1 = read(i1)
+            if len(unary_fns) == 1:
+                du = _vjp_unary(unary_fns[0], x1, ct)
+            else:
+                du = jax.lax.switch(
+                    o - NBc,
+                    [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
+                     for f in unary_fns], x1, ct)
+            store1(i1, mask01(du))
+
+
+def _zero_rows(operators: OperatorSet) -> int:
+    """Extra buffer rows for the dispatch plan (1 zero row when merged)."""
+    return 1 if _dispatch_plan(operators).merged else 0
 
 
 def _check_packable(operators: OperatorSet, base: int, max_steps: int) -> None:
     """Fail loudly (at trace time) when a configuration overflows the
-    packed fields: 12-bit operand addresses, 7-bit opcodes (bit 31 must
-    stay clear — the unpack uses an arithmetic shift)."""
-    n_codes = 1 + len(operators.binary) + len(operators.unary)
-    if base + max_steps > 4096:
+    packed fields: 12-bit operand addresses (incl. the zero row at
+    ``base + max_steps`` for merged plans), 6-bit opcodes when merged /
+    7-bit legacy (bit 31 must stay clear — the unpack uses an
+    arithmetic shift)."""
+    plan = _dispatch_plan(operators)
+    if base + max_steps + _zero_rows(operators) > 4096:
         raise ValueError(
-            f"Buffer address space {base + max_steps} exceeds the packed "
+            f"Buffer address space {base + max_steps + 1} exceeds the packed "
             f"12-bit operand field (nfeatures + cmax + max_nodes <= 4096)."
         )
-    if n_codes > 127:
+    if plan.merged and plan.n_branches > 63:
         raise ValueError(
-            f"{n_codes} merged opcodes exceed the packed 7-bit field.")
+            f"{plan.n_branches} merged opcodes exceed the packed 6-bit field.")
+    if not plan.merged and plan.n_branches > 127:
+        raise ValueError(
+            f"{plan.n_branches} opcodes exceed the packed 7-bit field.")
 
 
 def _make_program_kernel(
@@ -159,6 +381,8 @@ def _make_program_kernel(
         L = instr_ref.shape[-1]
 
         buf_ref[0:nfeat, :] = x_ref[...]
+        if _dispatch_plan(operators).merged:
+            buf_ref[BASE + L, :] = jnp.zeros((tile,), y_row.dtype)
 
         for t in range(tree_block):
             if nparam > 0:
@@ -181,28 +405,18 @@ def _make_program_kernel(
             jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
 
             def step(k, vmask):
-                o, i1, i2 = _unpack(instr_ref[t, k])
-                val = jax.lax.switch(
-                    o, _merged_branches(
-                        operators, lambda i: buf_ref[i, :], i1, i2))
+                val = _fwd_dispatch(
+                    operators, lambda i: buf_ref[i, :], instr_ref[t, k],
+                    y_row.dtype)
                 buf_ref[BASE + k, :] = val
                 return vmask * jnp.isfinite(val).astype(vmask.dtype)
 
             m = nstep_ref[t, 0]
-
-            # 2x-unrolled loop: the scalar-core loop overhead is a real
-            # fraction of the ~hundreds of cycles each step costs. Odd
-            # tails re-execute a clamped step idempotently (identity-coded
-            # padding rows read a real address; non-finite values there —
-            # possible only via param/const rows the wrapper already
-            # flags invalid — at worst re-poison an already-dead vmask).
-            def pair(k2, vmask):
-                vmask = step(2 * k2, vmask)
-                vmask = step(jnp.minimum(2 * k2 + 1, L - 1), vmask)
-                return vmask
-
+            # Plain loop: a 2x pair-unroll with a min-clamped tail was
+            # measured SLOWER than the loop bookkeeping it saves
+            # (profiling/kernel_variants.py, `nounroll`).
             vmask0 = jnp.ones((tile,), y_row.dtype)
-            vmask = jax.lax.fori_loop(0, (m + 1) >> 1, pair, vmask0)
+            vmask = jax.lax.fori_loop(0, m, step, vmask0)
             valid = jnp.all((vmask > 0) | jnp.logical_not(mask_row))
             pred = buf_ref[BASE + m - 1, :]
             elt = loss_fn(pred, y_row)
@@ -241,7 +455,7 @@ def fused_loss_program(
     *,
     params: Optional[jax.Array] = None,     # [T, NP, NC] member banks
     class_oh: Optional[jax.Array] = None,   # [NC, n] class one-hots
-    tree_block: int = 8,
+    tree_block: int = 16,
     tile_rows: int = 16384,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -260,7 +474,8 @@ def fused_loss_program(
 
     TB = tree_block
     bytes_per = jnp.dtype(dtype).itemsize
-    TILE = _pick_tile(n, tile_rows, BASE + L, bytes_per)
+    ZR = _zero_rows(operators)
+    TILE = _pick_tile(n, tile_rows, BASE + L + ZR, bytes_per)
     T_pad = _round_up(T, TB)
     n_pad = _round_up(n, TILE)
 
@@ -268,7 +483,7 @@ def fused_loss_program(
         return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
                        constant_values=fill)
 
-    instr = pad_t(_pack_instr(prog))
+    instr = pad_t(_pack_instr(prog, operators, BASE + L))
     nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
     nconst = pad_t(prog.nconst.reshape(-1, 1))
     cvals = pad_t(prog.cvals).astype(dtype)
@@ -326,7 +541,7 @@ def fused_loss_program(
             jax.ShapeDtypeStruct((T_pad, 1), dtype),
             jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
         ],
-        scratch_shapes=[pltpu.VMEM((BASE + L, TILE), dtype)],
+        scratch_shapes=[pltpu.VMEM((BASE + L + ZR, TILE), dtype)],
         interpret=interpret,
     )(*operands)
 
@@ -368,13 +583,13 @@ def _make_multi_kernel(
         nstep_ref,   # SMEM [TB, 1]
         nconst_ref,  # SMEM [TB, 1]
         cvals_ref,   # SMEM [TB, V * CMAX] f32 (variant-major)
-        x_ref,       # VMEM [F, TILE]
-        y_ref,       # VMEM [1, TILE]
-        w_ref,       # VMEM [1, TILE]
-        mask_ref,    # VMEM [1, TILE]
+        x_ref,       # VMEM [F, TILE] (buffer dtype)
+        y_ref,       # VMEM [1, TILE] f32
+        w_ref,       # VMEM [1, TILE] f32
+        mask_ref,    # VMEM [1, TILE] f32
         loss_ref,    # VMEM out [TB, V] f32
         valid_ref,   # VMEM out [TB, V] int32
-        buf_ref,     # VMEM scratch [BASE + L, V, TILE]
+        buf_ref,     # VMEM scratch [BASE + L + 1, V, TILE] (f32 or bf16)
     ):
         j = pl.program_id(1)
         y_row = y_ref[0, :]
@@ -382,39 +597,42 @@ def _make_multi_kernel(
         w_row = w_ref[0, :] * mask_ref[0, :]
         tile = y_row.shape[0]
         L = instr_ref.shape[-1]
+        bdt = buf_ref.dtype
 
         buf_ref[0:nfeat, :, :] = jnp.broadcast_to(
             x_ref[...][:, None, :], (nfeat, V, tile))
+        if _dispatch_plan(operators).merged:
+            buf_ref[BASE + L, :, :] = jnp.zeros((V, tile), bdt)
 
         for t in range(tree_block):
             def cbody(c, _):
                 for v in range(V):
                     buf_ref[nfeat + c, v, :] = jnp.full(
-                        (tile,), cvals_ref[t, v * cmax + c],
-                        dtype=y_row.dtype)
+                        (tile,), cvals_ref[t, v * cmax + c], dtype=bdt)
                 return 0
 
             jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
 
             def step(k, vmask):
-                o, i1, i2 = _unpack(instr_ref[t, k])
-                val = jax.lax.switch(
-                    o, _merged_branches(
-                        operators, lambda i: buf_ref[i, :, :], i1, i2))
-                buf_ref[BASE + k, :, :] = val
+                # dispatch computes in f32; the store downcasts. The
+                # finiteness check runs on the f32 value (bf16 compares
+                # don't lower) — a value that only overflows at the bf16
+                # downcast surfaces one step later, or in the final loss.
+                val = _fwd_dispatch(
+                    operators, lambda i: buf_ref[i, :, :], instr_ref[t, k],
+                    bdt)
+                buf_ref[BASE + k, :, :] = val.astype(bdt)
                 return vmask * jnp.isfinite(val).astype(vmask.dtype)
 
             m = nstep_ref[t, 0]
-
-            def pair(k2, vmask):
-                vmask = step(2 * k2, vmask)
-                return step(jnp.minimum(2 * k2 + 1, L - 1), vmask)
-
             vmask0 = jnp.ones((V, tile), y_row.dtype)
-            vmask = jax.lax.fori_loop(0, (m + 1) >> 1, pair, vmask0)
+            vmask = jax.lax.fori_loop(0, m, step, vmask0)
             validv = jnp.all(
                 (vmask > 0) | jnp.logical_not(mask_row)[None, :], axis=1)
-            pred = buf_ref[BASE + m - 1, :, :]            # [V, TILE]
+            # Loss in f32 regardless of the buffer dtype: the tree is
+            # evaluated in ``bdt``, the elementwise loss and row
+            # reduction accumulate at full precision.
+            pred = buf_ref[BASE + m - 1, :, :].astype(y_row.dtype)
             elt = loss_fn(pred, y_row[None, :])
             elt = jnp.where(w_row[None, :] > 0, elt, 0.0)
             partial = jnp.sum(elt * w_row[None, :], axis=1)  # [V]
@@ -436,7 +654,8 @@ def _make_multi_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "nfeatures", "operators", "loss_fn", "tree_block", "interpret",
+        "nfeatures", "operators", "loss_fn", "tree_block", "bf16",
+        "interpret",
     ),
 )
 def fused_loss_multi(
@@ -450,6 +669,7 @@ def fused_loss_multi(
     loss_fn: Callable,
     *,
     tree_block: int = 8,
+    bf16: bool = False,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Mean loss for every (tree, constant-variant) pair: [T, V] each.
@@ -457,32 +677,52 @@ def fused_loss_multi(
     One instruction-stream dispatch per tree serves all V variants;
     invalid pairs (non-finite eval or non-finite constants) get inf.
 
-    Large V is processed in chunks of 8: VMEM caps (buffer rows × V ×
-    row-tile) force tiny row tiles at big V, and small tiles multiply
-    the per-step dispatch count — 8 variants × ~5k-row tiles is the
-    sweet spot on v5e (measured).
+    The dominant cost is per-step dispatch, paid once per (V-chunk ×
+    row-tile); VMEM caps V_chunk × TILE. Large V is processed in chunks
+    of 8 (f32) — measured sweet spot on v5e.
+
+    ``bf16``: the value buffer (and the tree evaluation) run in
+    bfloat16, halving VMEM per variant so chunks double to 16 and the
+    per-step dispatch cost per eval halves; the elementwise loss and row
+    reduction still accumulate in f32. bf16 carries f32's exponent range
+    (~3 significant digits), so losses rank reliably but fine
+    loss *differences* are noisy — callers must re-verify accepted
+    points at f32 (the BFGS line search recomputes f at the accepted
+    step via the f32 gradient kernel and rejects non-descent).
     """
     V = cvals_v.shape[1]
-    if V > 8:
-        outs = [
-            fused_loss_multi(
-                prog, cvals_v[:, v0:v0 + 8], X, y, weights, nfeatures,
-                operators, loss_fn, tree_block=tree_block,
-                interpret=interpret)
-            for v0 in range(0, V, 8)
-        ]
-        return (jnp.concatenate([o[0] for o in outs], axis=1),
-                jnp.concatenate([o[1] for o in outs], axis=1))
     T, L = prog.code.shape
     CMAX = prog.cmax
     F, n = X.shape
     dtype = X.dtype
+    buf_dtype = jnp.bfloat16 if bf16 else dtype
     BASE = nfeatures + CMAX
+    rows = BASE + L + _zero_rows(operators)
+    bytes_per = jnp.dtype(buf_dtype).itemsize
+
+    # Chunks of 8 (f32) / 16 (bf16): the obvious "fewer dispatch passes"
+    # alternatives were measured NEUTRAL-or-worse on the bench — one f32
+    # V=24 chunk at 2.5k-row tiles (4 passes vs 6) lands within noise of
+    # this plan (per-pass fixed costs offset the saved dispatches), and
+    # bf16 V=16 chunks lose outright to per-step bf16<->f32 relayouts.
+    VCH = 16 if bf16 else 8
+    if V > VCH:
+        outs = [
+            fused_loss_multi(
+                prog, cvals_v[:, v0:v0 + VCH], X, y, weights, nfeatures,
+                operators, loss_fn, tree_block=tree_block, bf16=bf16,
+                interpret=interpret)
+            for v0 in range(0, V, VCH)
+        ]
+        return (jnp.concatenate([o[0] for o in outs], axis=1),
+                jnp.concatenate([o[1] for o in outs], axis=1))
     _check_packable(operators, BASE, L)
 
     TB = tree_block
-    bytes_per = jnp.dtype(dtype).itemsize
-    TILE = _pick_tile(n, n, (BASE + L) * V, bytes_per, budget=8 * 2**20)
+    # bf16 tiles the (V, TILE) plane in (16, 128) blocks — size VMEM by
+    # the sublane-padded variant count.
+    V_phys = _round_up(V, 16) if bf16 else V
+    TILE = _pick_tile(n, n, rows * V_phys, bytes_per, budget=8 * 2**20)
     T_pad = _round_up(T, TB)
     n_pad = _round_up(n, TILE)
 
@@ -490,12 +730,12 @@ def fused_loss_multi(
         return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
                        constant_values=fill)
 
-    instr = pad_t(_pack_instr(prog))
+    instr = pad_t(_pack_instr(prog, operators, BASE + L))
     nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
     nconst = pad_t(prog.nconst.reshape(-1, 1))
     cflat = pad_t(cvals_v.reshape(T, V * CMAX)).astype(dtype)
 
-    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+    Xp = jnp.pad(X.astype(buf_dtype), ((0, 0), (0, n_pad - n)))
     yp = jnp.pad(y.reshape(1, n), ((0, 0), (0, n_pad - n)))
     w = (jnp.ones((1, n), dtype) if weights is None
          else weights.reshape(1, n).astype(dtype))
@@ -532,7 +772,7 @@ def fused_loss_multi(
             jax.ShapeDtypeStruct((T_pad, V), dtype),
             jax.ShapeDtypeStruct((T_pad, V), jnp.int32),
         ],
-        scratch_shapes=[pltpu.VMEM((BASE + L, V, TILE), dtype)],
+        scratch_shapes=[pltpu.VMEM((rows, V, TILE), buf_dtype)],
         interpret=interpret,
     )(instr, nsteps, nconst, cflat, Xp, yp, wp, maskp)
 
@@ -569,8 +809,6 @@ def _make_multi_grad_kernel(
     cmax: int,
     nvar: int,
 ):
-    unary_fns = tuple(op.fn for op in operators.unary)
-    binary_fns = tuple(op.fn for op in operators.binary)
     BASE = nfeat + cmax
     V = nvar
 
@@ -586,20 +824,22 @@ def _make_multi_grad_kernel(
         loss_ref,    # VMEM out [TB, V] f32
         valid_ref,   # VMEM out [TB, V] int32
         gcomp_ref,   # VMEM out [TB, CMAX, V] — d loss_sum / d cvals
-        buf_ref,     # VMEM scratch [BASE + L, V, TILE]
-        adj_ref,     # VMEM scratch [BASE + L, V, TILE]
+        buf_ref,     # VMEM scratch [BASE + L + 1, V, TILE]
+        adj_ref,     # VMEM scratch [BASE + L + 1, V, TILE] (last row: the
+                     # zero row's adjoint — written, never read)
     ):
         j = pl.program_id(1)
         y_row = y_ref[0, :]
         mask_row = mask_ref[0, :] > 0
         w_row = w_ref[0, :] * mask_ref[0, :]
         tile = y_row.shape[0]
-        B = len(binary_fns)
         L = instr_ref.shape[-1]
         read = lambda i: buf_ref[i, :, :]
 
         buf_ref[0:nfeat, :, :] = jnp.broadcast_to(
             x_ref[...][:, None, :], (nfeat, V, tile))
+        if _dispatch_plan(operators).merged:
+            buf_ref[BASE + L, :, :] = jnp.zeros((V, tile), y_row.dtype)
 
         for t in range(tree_block):
             def cbody(c, _):
@@ -612,20 +852,14 @@ def _make_multi_grad_kernel(
             jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
 
             def fwd(k, vmask):
-                o, i1, i2 = _unpack(instr_ref[t, k])
-                val = jax.lax.switch(
-                    o, _merged_branches(operators, read, i1, i2))
+                val = _fwd_dispatch(
+                    operators, read, instr_ref[t, k], y_row.dtype)
                 buf_ref[BASE + k, :, :] = val
                 return vmask * jnp.isfinite(val).astype(vmask.dtype)
 
             m = nstep_ref[t, 0]
-
-            def fwd_pair(k2, vmask):
-                vmask = fwd(2 * k2, vmask)
-                return fwd(jnp.minimum(2 * k2 + 1, L - 1), vmask)
-
             vmask = jax.lax.fori_loop(
-                0, (m + 1) >> 1, fwd_pair, jnp.ones((V, tile), y_row.dtype))
+                0, m, fwd, jnp.ones((V, tile), y_row.dtype))
             validv = jnp.all(
                 (vmask > 0) | jnp.logical_not(mask_row)[None, :], axis=1)
 
@@ -647,57 +881,18 @@ def _make_multi_grad_kernel(
             # only over the nconst used rows.
             adj_ref[BASE + m - 1, :, :] = dpred
 
-            def bwd(k):
-                o, i1, i2 = _unpack(instr_ref[t, k])
+            def bwd(i, _):
+                k = m - 1 - i
                 ct = adj_ref[BASE + k, :, :]
 
-                # Padded rows carry zero cotangents but arbitrary operand
-                # values, so vjps can produce 0/0 = NaN there; mask before
-                # storing or one NaN poisons the gradient sums.
-                @pl.when(o == 0)
-                def _():
-                    adj_ref[i1, :, :] = ct
+                def store(a, v):
+                    adj_ref[a, :, :] = v
 
-                if binary_fns:
-                    @pl.when((o >= 1) & (o <= B))
-                    def _():
-                        x1 = read(i1)
-                        x2 = read(i2)
-                        if len(binary_fns) == 1:
-                            db1, db2 = _vjp_binary(binary_fns[0], x1, x2, ct)
-                        else:
-                            db1, db2 = jax.lax.switch(
-                                o - 1,
-                                [lambda xx, yy, cc, f=f:
-                                 _vjp_binary(f, xx, yy, cc)
-                                 for f in binary_fns], x1, x2, ct)
-                        adj_ref[i1, :, :] = jnp.where(
-                            mask_row[None, :], db1, 0.0)
-                        adj_ref[i2, :, :] = jnp.where(
-                            mask_row[None, :], db2, 0.0)
-
-                if unary_fns:
-                    @pl.when(o > B)
-                    def _():
-                        x1 = read(i1)
-                        if len(unary_fns) == 1:
-                            du = _vjp_unary(unary_fns[0], x1, ct)
-                        else:
-                            du = jax.lax.switch(
-                                o - 1 - B,
-                                [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
-                                 for f in unary_fns], x1, ct)
-                        adj_ref[i1, :, :] = jnp.where(
-                            mask_row[None, :], du, 0.0)
-
-            def bwd_pair(i2x, _):
-                # descending, 2x-unrolled; the odd tail re-executes step 0
-                # idempotently (pure assignments make that safe).
-                bwd(m - 1 - 2 * i2x)
-                bwd(jnp.maximum(m - 2 - 2 * i2x, 0))
+                _bwd_dispatch(operators, read, instr_ref[t, k], ct,
+                              mask_row[None, :], store, store)
                 return 0
 
-            jax.lax.fori_loop(0, (m + 1) >> 1, bwd_pair, 0)
+            jax.lax.fori_loop(0, m, bwd, 0)
 
             @pl.when(j == 0)
             def _():
@@ -771,7 +966,9 @@ def fused_grad_multi(
 
     TB = tree_block
     bytes_per = jnp.dtype(dtype).itemsize
-    TILE = _pick_tile(n, n, 2 * (BASE + L) * V, bytes_per, budget=8 * 2**20)
+    ZR = _zero_rows(operators)
+    TILE = _pick_tile(n, n, 2 * (BASE + L + ZR) * V, bytes_per,
+                      budget=8 * 2**20)
     T_pad = _round_up(T, TB)
     n_pad = _round_up(n, TILE)
 
@@ -779,7 +976,7 @@ def fused_grad_multi(
         return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
                        constant_values=fill)
 
-    instr = pad_t(_pack_instr(prog))
+    instr = pad_t(_pack_instr(prog, operators, BASE + L))
     nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
     nconst = pad_t(prog.nconst.reshape(-1, 1))
     cflat = pad_t(cvals_v.reshape(T, V * CMAX)).astype(dtype)
@@ -825,8 +1022,8 @@ def fused_grad_multi(
             jax.ShapeDtypeStruct((T_pad, CMAX, V), dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((BASE + L, V, TILE), dtype),
-            pltpu.VMEM((BASE + L, V, TILE), dtype),
+            pltpu.VMEM((BASE + L + ZR, V, TILE), dtype),
+            pltpu.VMEM((BASE + L + ZR, V, TILE), dtype),
         ],
         interpret=interpret,
     )(instr, nsteps, nconst, cflat, Xp, yp, wp, maskp)
@@ -966,7 +1163,7 @@ def _make_program_predict_kernel(
         mask_ref,    # VMEM [1, TILE]
         pred_ref,    # VMEM out [TB, TILE]
         valid_ref,   # SMEM out [TB, 1] int32
-        buf_ref,     # VMEM scratch [BASE + L, TILE]
+        buf_ref,     # VMEM scratch [BASE + L + 1, TILE]
     ):
         j = pl.program_id(1)
         mask_row = mask_ref[0, :] > 0
@@ -976,6 +1173,8 @@ def _make_program_predict_kernel(
 
         if not per_member:
             buf_ref[0:nfeat, :] = x_ref[...]
+        if _dispatch_plan(operators).merged:
+            buf_ref[BASE + L, :] = jnp.zeros((tile,), dtype)
 
         for t in range(tree_block):
             if per_member:
@@ -989,21 +1188,15 @@ def _make_program_predict_kernel(
             jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
 
             def step(k, vmask):
-                o, i1, i2 = _unpack(instr_ref[t, k])
-                val = jax.lax.switch(
-                    o, _merged_branches(
-                        operators, lambda i: buf_ref[i, :], i1, i2))
+                val = _fwd_dispatch(
+                    operators, lambda i: buf_ref[i, :], instr_ref[t, k],
+                    dtype)
                 buf_ref[BASE + k, :] = val
                 return vmask * jnp.isfinite(val).astype(vmask.dtype)
 
             m = nstep_ref[t, 0]
-
-            def pair(k2, vmask):
-                vmask = step(2 * k2, vmask)
-                return step(jnp.minimum(2 * k2 + 1, L - 1), vmask)
-
             vmask = jax.lax.fori_loop(
-                0, (m + 1) >> 1, pair, jnp.ones((tile,), dtype))
+                0, m, step, jnp.ones((tile,), dtype))
             valid = jnp.all((vmask > 0) | jnp.logical_not(mask_row))
             pred_ref[t, :] = buf_ref[BASE + m - 1, :]
             partial_ok = jnp.int32(valid) * ok_ref[t, 0]
@@ -1029,7 +1222,7 @@ def fused_predict_program(
     nfeatures: int,
     operators: OperatorSet,
     *,
-    tree_block: int = 8,
+    tree_block: int = 16,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-tree row predictions (pred [T, n], valid [T]) for compiled
@@ -1044,9 +1237,12 @@ def fused_predict_program(
     BASE = nfeatures + CMAX
     _check_packable(operators, BASE, L)
 
-    TB = tree_block
+    # Per-member mode streams [TB, F, TILE] X tiles; cap the block so the
+    # doubled-buffered input tiles don't crowd VMEM.
+    TB = min(tree_block, 8) if per_member else tree_block
     bytes_per = jnp.dtype(dtype).itemsize
-    TILE = _pick_tile(n, 16384, BASE + L, bytes_per)
+    ZR = _zero_rows(operators)
+    TILE = _pick_tile(n, 16384, BASE + L + ZR, bytes_per)
     T_pad = _round_up(T, TB)
     n_pad = _round_up(n, TILE)
 
@@ -1054,7 +1250,7 @@ def fused_predict_program(
         return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
                        constant_values=fill)
 
-    instr = pad_t(_pack_instr(prog))
+    instr = pad_t(_pack_instr(prog, operators, BASE + L))
     nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
     nconst = pad_t(prog.nconst.reshape(-1, 1))
     cvals = pad_t(prog.cvals).astype(dtype)
@@ -1097,7 +1293,7 @@ def fused_predict_program(
             jax.ShapeDtypeStruct((T_pad, n_pad), dtype),
             jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
         ],
-        scratch_shapes=[pltpu.VMEM((BASE + L, TILE), dtype)],
+        scratch_shapes=[pltpu.VMEM((BASE + L + ZR, TILE), dtype)],
         interpret=interpret,
     )(instr, nsteps, nconst, cvals, ok, Xp, maskp)
 
@@ -1153,9 +1349,6 @@ def _make_program_predict_vjp_kernel(
     cmax: int,
     per_member: bool,
 ):
-    unary_fns = tuple(op.fn for op in operators.unary)
-    binary_fns = tuple(op.fn for op in operators.binary)
-    B = len(binary_fns)
     BASE = nfeat + cmax
 
     def kernel(
@@ -1168,8 +1361,8 @@ def _make_program_predict_vjp_kernel(
         mask_ref,    # VMEM [1, TILE]
         gcomp_ref,   # SMEM out [TB, CMAX] (scalar stores)
         gx_ref,      # VMEM out [TB, F, TILE] (dummy [TB, 1, TILE] if shared)
-        buf_ref,     # VMEM scratch [BASE + L, TILE]
-        adj_ref,     # VMEM scratch [BASE + L, TILE]
+        buf_ref,     # VMEM scratch [BASE + L + 1, TILE]
+        adj_ref,     # VMEM scratch [BASE + L + 1, TILE]
     ):
         j = pl.program_id(1)
         mask_row = mask_ref[0, :] > 0
@@ -1180,6 +1373,8 @@ def _make_program_predict_vjp_kernel(
 
         if not per_member:
             buf_ref[0:nfeat, :] = x_ref[...]
+        if _dispatch_plan(operators).merged:
+            buf_ref[BASE + L, :] = jnp.zeros((tile,), dtype)
 
         for t in range(tree_block):
             if per_member:
@@ -1193,18 +1388,12 @@ def _make_program_predict_vjp_kernel(
             jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
 
             def fwd(k, _):
-                o, i1, i2 = _unpack(instr_ref[t, k])
-                buf_ref[BASE + k, :] = jax.lax.switch(
-                    o, _merged_branches(operators, read, i1, i2))
+                buf_ref[BASE + k, :] = _fwd_dispatch(
+                    operators, read, instr_ref[t, k], dtype)
                 return 0
 
             m = nstep_ref[t, 0]
-
-            def fwd_pair(k2, _):
-                fwd(2 * k2, 0)
-                return fwd(jnp.minimum(2 * k2 + 1, L - 1), 0)
-
-            jax.lax.fori_loop(0, (m + 1) >> 1, fwd_pair, 0)
+            jax.lax.fori_loop(0, m, fwd, 0)
 
             # X-region adjoints accumulate (same argument can appear at
             # several leaves); tree regions are written exactly once.
@@ -1220,57 +1409,14 @@ def _make_program_predict_vjp_kernel(
                 def _():
                     adj_ref[iaddr, :] = val
 
-            def bwd(k):
-                o, i1, i2 = _unpack(instr_ref[t, k])
+            def bwd(i, _):
+                k = m - 1 - i
                 ct = adj_ref[BASE + k, :]
-
-                @pl.when(o == 0)
-                def _():
-                    store_adj(i1, ct)
-
-                if binary_fns:
-                    @pl.when((o >= 1) & (o <= B))
-                    def _():
-                        x1 = read(i1)
-                        x2 = read(i2)
-                        if len(binary_fns) == 1:
-                            db1, db2 = _vjp_binary(binary_fns[0], x1, x2, ct)
-                        else:
-                            db1, db2 = jax.lax.switch(
-                                o - 1,
-                                [lambda xx, yy, cc, f=f:
-                                 _vjp_binary(f, xx, yy, cc)
-                                 for f in binary_fns], x1, x2, ct)
-                        store_adj(i1, jnp.where(mask_row, db1, 0.0))
-                        store_adj(i2, jnp.where(mask_row, db2, 0.0))
-
-                if unary_fns:
-                    @pl.when(o > B)
-                    def _():
-                        x1 = read(i1)
-                        if len(unary_fns) == 1:
-                            du = _vjp_unary(unary_fns[0], x1, ct)
-                        else:
-                            du = jax.lax.switch(
-                                o - 1 - B,
-                                [lambda xx, cc, f=f: _vjp_unary(f, xx, cc)
-                                 for f in unary_fns], x1, ct)
-                        store_adj(i1, jnp.where(mask_row, du, 0.0))
-
-            def bwd_pair(i2x, _):
-                # X-region adjoints ACCUMULATE, so the odd tail must be
-                # guarded, not clamped — re-executing step 0 would
-                # double-count its argument contributions.
-                bwd(m - 1 - 2 * i2x)
-                k2 = m - 2 - 2 * i2x
-
-                @pl.when(k2 >= 0)
-                def _():
-                    bwd(k2)
-
+                _bwd_dispatch(operators, read, instr_ref[t, k], ct,
+                              mask_row, store_adj, store_adj)
                 return 0
 
-            jax.lax.fori_loop(0, (m + 1) >> 1, bwd_pair, 0)
+            jax.lax.fori_loop(0, m, bwd, 0)
 
             @pl.when(j == 0)
             def _():
@@ -1317,7 +1463,8 @@ def _fused_predict_vjp_program(
 
     TB = tree_block
     bytes_per = jnp.dtype(dtype).itemsize
-    TILE = _pick_tile(n, 16384, 2 * (BASE + L), bytes_per)
+    ZR = _zero_rows(operators)
+    TILE = _pick_tile(n, 16384, 2 * (BASE + L + ZR), bytes_per)
     T_pad = _round_up(T, TB)
     n_pad = _round_up(n, TILE)
 
@@ -1325,7 +1472,7 @@ def _fused_predict_vjp_program(
         return jnp.pad(x, ((0, T_pad - T),) + ((0, 0),) * (x.ndim - 1),
                        constant_values=fill)
 
-    instr = pad_t(_pack_instr(prog))
+    instr = pad_t(_pack_instr(prog, operators, BASE + L))
     nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
     nconst = pad_t(prog.nconst.reshape(-1, 1))
     cvals = pad_t(prog.cvals).astype(dtype)
@@ -1372,8 +1519,8 @@ def _fused_predict_vjp_program(
             jax.ShapeDtypeStruct((T_pad, FG, n_pad), dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((BASE + L, TILE), dtype),
-            pltpu.VMEM((BASE + L, TILE), dtype),
+            pltpu.VMEM((BASE + L + ZR, TILE), dtype),
+            pltpu.VMEM((BASE + L + ZR, TILE), dtype),
         ],
         interpret=interpret,
     )(instr, nsteps, nconst, cvals, Xp, ctp, maskp)

@@ -152,6 +152,73 @@ def test_fused_loss_multi_matches_replication(setup):
         assert np.all(np.isinf(np.asarray(l_multi[:, v])[~ok]))
 
 
+def test_fused_loss_multi_bf16_ranks_like_f32(setup):
+    """bf16 line-search mode: ~3-digit losses, identical inf pattern,
+    and (well-separated) variants rank the same as f32 — the contract
+    the BFGS step-size selection relies on."""
+    from symbolicregression_jl_tpu.ops.fused_eval import fused_loss_multi
+    from symbolicregression_jl_tpu.ops.program import compile_program
+
+    opts, cfg, X, y = setup
+    opset = cfg.operators
+    trees = init_population(jax.random.PRNGKey(11), 8, cfg.mctx, jnp.float32)
+    F = X.shape[0]
+    prog = compile_program(trees, F, len(opset.binary))
+    V = 20  # exercises the bf16 V-chunking (16 + remainder)
+    rng = np.random.default_rng(3)
+    cvals_v = jnp.asarray(
+        np.asarray(prog.cvals)[:, None, :]
+        * (1.0 + rng.normal(0, 0.5, (8, V, prog.cmax)).astype(np.float32))
+    )
+    cvals_v = cvals_v.at[1, 3, 0].set(jnp.nan)
+    l32, v32 = fused_loss_multi(
+        prog, cvals_v, X, y, None, F, opset, l2_dist_loss, interpret=True)
+    l16, v16 = fused_loss_multi(
+        prog, cvals_v, X, y, None, F, opset, l2_dist_loss, bf16=True,
+        interpret=True)
+    assert l16.shape == (8, V)
+    assert np.array_equal(np.asarray(v32), np.asarray(v16))
+    a, b = np.asarray(l32), np.asarray(l16)
+    assert np.array_equal(np.isfinite(a), np.isfinite(b))
+    fin = np.isfinite(a)
+    rel = np.abs(a[fin] - b[fin]) / (1e-6 + np.abs(a[fin]))
+    # bf16 evals track f32 to ~3 digits in the typical case; individual
+    # cancellation-heavy trees (x - 0.99x chains) can diverge by large
+    # factors — that is exactly why acceptance re-verifies at f32.
+    assert np.median(rel) < 0.02, np.median(rel)
+    # the argmin variant agrees whenever f32 separates it clearly (2x)
+    am = a.argmin(axis=1)
+    for t in range(8):
+        srt = np.sort(a[t][np.isfinite(a[t])])
+        if len(srt) >= 2 and srt[1] > srt[0] * 2.0:
+            assert b[t].argmin() == am[t]
+
+
+def test_fused_optimizer_bf16_linesearch_still_descends(setup):
+    """ls_bf16 BFGS: the f32 descent guard keeps accepted losses at or
+    below the baseline, and constants still converge on a recoverable
+    problem."""
+    from symbolicregression_jl_tpu.evolve.constant_opt import (
+        OptimizerConfig, optimize_constants_fused)
+
+    opts, cfg, X, y = setup
+    data = type("D", (), {"Xt": X, "y": y, "weights": None})()
+    trees = init_population(jax.random.PRNGKey(13), 16, cfg.mctx, jnp.float32)
+    do_opt = jnp.ones((16,), bool)
+    base_cfg = OptimizerConfig(iterations=4, nrestarts=1)
+    new_c, improved, new_loss, calls = optimize_constants_fused(
+        jax.random.PRNGKey(0), trees, do_opt, data, l2_dist_loss,
+        cfg.operators, base_cfg._replace(ls_bf16=True), interpret=True)
+    l0, _ = fused_loss(trees, X, y, None, cfg.operators, l2_dist_loss,
+                       interpret=True)
+    l0 = np.where(np.isfinite(np.asarray(l0)), np.asarray(l0), np.inf)
+    # accepted losses never exceed the pre-optimization baseline
+    nl = np.asarray(new_loss)
+    ok = np.isfinite(l0)
+    assert np.all(nl[ok] <= l0[ok] + 1e-5)
+    assert bool(np.any(np.asarray(improved)))
+
+
 def test_fused_constant_optimizer(setup):
     """Fused batched-line-search BFGS recovers known constants
     (optimize_constants semantics, src/ConstantOptimization.jl:29-113)."""

@@ -157,3 +157,78 @@ class TestEncoding:
         batch = encode_population(trees, 16, OPS)
         back = decode_population(batch, OPS)
         assert all(a == b for a, b in zip(trees, back))
+
+
+class TestDeviceFold:
+    """Batched device-side constant folding (evolve.simplify) — the
+    whole-population analogue of simplify_tree! (SingleIteration.jl:79-85).
+    Pinned directly: a span/cover off-by-one would corrupt trees while
+    engine-level tests still pass statistically."""
+
+    def _pop(self, n=256, seed=0):
+        import jax
+        import jax.numpy as jnp
+
+        from symbolicregression_jl_tpu.evolve.mutation import MutationContext
+        from symbolicregression_jl_tpu.evolve.population import init_population
+
+        ops = OperatorSet(binary_operators=["+", "-", "*", "/"],
+                          unary_operators=["exp", "cos"])
+        mctx = MutationContext(
+            nops=ops.nops_tuple(), nfeatures=3, max_nodes=21,
+            perturbation_factor=0.076, probability_negate_constant=0.01)
+        trees = init_population(
+            jax.random.PRNGKey(seed), n, mctx, jnp.float32)
+        return ops, trees
+
+    def test_fold_eval_equivalence_and_idempotence(self):
+        import jax
+        import jax.numpy as jnp
+
+        from symbolicregression_jl_tpu.evolve.simplify import (
+            fold_constants_batch)
+        from symbolicregression_jl_tpu.ops.eval import eval_tree_batch
+
+        ops, trees = self._pop()
+        rng = np.random.default_rng(0)
+        X = jnp.asarray(rng.uniform(-2, 2, (3, 64)).astype(np.float32))
+        folded = fold_constants_batch(trees, ops)
+        y0, v0 = eval_tree_batch(trees, X, ops)
+        y1, v1 = eval_tree_batch(folded, X, ops)
+        a, b = np.asarray(y0), np.asarray(y1)
+        va, vb = np.asarray(v0), np.asarray(v1)
+        # folding never grows trees, and lengths stay positive
+        assert np.all(np.asarray(folded.length) >= 1)
+        assert np.all(np.asarray(folded.length) <= np.asarray(trees.length))
+        both = va & vb
+        assert np.allclose(a[both], b[both], rtol=1e-5, atol=1e-5)
+        # a fold can only change validity via rounding at the folded
+        # constant; on this population none should flip
+        assert (va == vb).mean() > 0.99
+        # idempotence: folding a folded population is a no-op
+        again = fold_constants_batch(folded, ops)
+        for f in ("arity", "op", "feat", "length"):
+            assert np.array_equal(
+                np.asarray(getattr(again, f)), np.asarray(getattr(folded, f))
+            ), f
+        assert np.allclose(np.asarray(again.const), np.asarray(folded.const),
+                           equal_nan=True)
+
+    def test_fold_collapses_known_shapes(self):
+        import jax.numpy as jnp
+
+        from symbolicregression_jl_tpu.evolve.simplify import (
+            fold_constants_batch)
+        from symbolicregression_jl_tpu.ops.encoding import (
+            decode_tree, encode_tree)
+
+        ops = OperatorSet(binary_operators=["+", "-", "*", "/"],
+                          unary_operators=["exp", "cos"])
+        t = parse_expression("x1 + (2.0 + 3.0)", ops)
+        enc = encode_tree(t, 15, ops)
+        batch = TreeBatch(*[jnp.asarray(f)[None] for f in enc])
+        folded = fold_constants_batch(batch, ops)
+        out = decode_tree(
+            *[np.asarray(getattr(folded, f))[0]
+              for f in ("arity", "op", "feat", "const", "length")], ops)
+        assert string_tree(out) == "x1 + 5.0"
