@@ -1,0 +1,97 @@
+"""Correctness + perf microbench of fused_loss_dedup vs fused_loss_program.
+
+Captures a REAL evolved candidate batch (same protocol as dup_rate.py),
+then times both eval paths on it on the real chip.
+
+Usage: dedup_bench.py [islands] [pop] [V]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from _common import make_bench_problem, timeit
+
+
+def main():
+    I = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    P = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    V = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+
+    from symbolicregression_jl_tpu import search_key
+    from symbolicregression_jl_tpu.evolve.step import generation_step
+    from symbolicregression_jl_tpu.ops.program import compile_program
+    from symbolicregression_jl_tpu.ops.fused_eval import (
+        fused_loss_dedup, fused_loss_program)
+
+    options, ds, engine = make_bench_problem(
+        populations=I, population_size=P, ncycles_per_iteration=100,
+        tournament_selection_n=16)
+    cfg = engine.cfg
+    state = engine.init_state(search_key(0), ds.data, I)
+    for _ in range(2):
+        state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+
+    @jax.jit
+    def capture(key, pops, birth, ref, stats_nf, marks):
+        def island(k, pop, b, r, m):
+            return generation_step(
+                k, pop, ds.data, stats_nf, jnp.float32(0.5),
+                jnp.int32(options.maxsize), b, r, cfg, options,
+                engine.tables, options.elementwise_loss, marks=m,
+                return_candidates=True)
+        return jax.vmap(island)(key, pops, birth, ref, marks)
+
+    marks = (jnp.zeros((I, P), jnp.bool_), jnp.zeros((I, P), jnp.bool_))
+    keys = jax.random.split(state.key, I)
+    out = capture(keys, state.pops, state.birth, state.ref,
+                  state.stats.normalized_frequencies, marks)
+    cand = out[-1]
+    flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), cand)
+    T = flat.arity.shape[0]
+    print(f"captured candidate batch: {T} trees")
+
+    n_binary = len(cfg.operators.binary)
+    F = ds.nfeatures
+    prog = jax.jit(lambda t: compile_program(t, F, n_binary))(flat)
+    prog = jax.block_until_ready(prog)
+
+    X, y, w = ds.data.Xt, ds.data.y, ds.data.weights
+    el = options.elementwise_loss
+
+    f_plain = jax.jit(lambda p: fused_loss_program(
+        p, X, y, w, F, cfg.operators, el))
+    f_dedup = jax.jit(lambda p: fused_loss_dedup(
+        p, X, y, w, F, cfg.operators, el))
+
+    la, va = jax.block_until_ready(f_plain(prog))
+    lb, vb = jax.block_until_ready(f_dedup(prog))
+    la, va, lb, vb = map(np.asarray, (la, va, lb, vb))
+    both_finite = np.isfinite(la) & np.isfinite(lb)
+    exact = np.mean((la == lb) | (~np.isfinite(la) & ~np.isfinite(lb)))
+    if both_finite.any():
+        rel = np.abs(la[both_finite] - lb[both_finite]) / np.maximum(
+            np.abs(la[both_finite]), 1e-30)
+        print(f"agreement: exact {exact:.4f}, max rel diff "
+              f"{rel.max():.3e}, valid mismatch {(va != vb).mean():.5f}")
+    inf_a, inf_b = (~np.isfinite(la)).mean(), (~np.isfinite(lb)).mean()
+    print(f"inf rates: plain {inf_a:.4f} dedup {inf_b:.4f}")
+
+    ta = timeit(f_plain, prog, n=20, warmup=3)
+    tb = timeit(f_dedup, prog, n=20, warmup=3)
+    print(f"plain : {ta * 1e3:8.3f} ms/launch ({T / ta:,.0f} trees/s)")
+    print(f"dedup : {tb * 1e3:8.3f} ms/launch ({T / tb:,.0f} trees/s) "
+          f"speedup {ta / tb:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

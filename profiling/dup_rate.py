@@ -1,0 +1,175 @@
+"""Measure structural duplication in the candidate-eval batch.
+
+Round-3 verdict: the roofline note dismissed "batch structurally
+identical trees" without measuring the duplicate-structure rate in
+evolved populations. This harness measures it directly on the bench
+config: warm the engine with real iterations, then step single
+generation cycles with `generation_step(..., return_candidates=True)`
+and count, per cycle:
+
+  - per-island candidate dup rate: fraction of the island's eval batch
+    whose compiled (code, src1, src2)[:nsteps] rows duplicate another
+    row of the same island (constants free) — this is the rate a
+    per-island (inside the island vmap) dedup can exploit;
+  - global candidate dup rate: same, across all islands — the ceiling
+    for a flattened-batch dedup;
+  - full-identity rates: structure AND constants identical (these
+    rows wouldn't even need a variants axis);
+  - the same four numbers for the population itself (the finalize-eval
+    batch [I, P]).
+
+Usage: dup_rate.py [islands] [pop] [cycles_to_sample] [warm_iters]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from _common import make_bench_problem
+
+
+def dup_stats(code, src1, src2, nsteps, cvals=None, nconst=None):
+    """(dup_rate, groups>1 mean size) for [T, L] program structure rows.
+
+    Slots past nsteps are masked (the kernel never reads them; their
+    residual leaf-address content must not split groups).
+    """
+    T, L = code.shape
+    step = np.arange(L)[None, :]
+    live = step < nsteps[:, None]
+    rows = [np.where(live, code, 0), np.where(live, src1, 0),
+            np.where(live, src2, 0), nsteps[:, None]]
+    if cvals is not None:
+        cused = np.arange(cvals.shape[1])[None, :] < nconst[:, None]
+        rows.append(np.where(cused, cvals, 0.0).view(np.int32))
+    mat = np.concatenate(rows, axis=1)
+    uniq, counts = np.unique(mat, axis=0, return_counts=True)
+    dup_rate = 1.0 - len(uniq) / T
+    big = counts[counts > 1]
+    mean_group = float(big.mean()) if len(big) else 0.0
+    return dup_rate, mean_group, counts
+
+
+def main():
+    I = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    P = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    NCAP = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+    WARM = int(sys.argv[4]) if len(sys.argv) > 4 else 2
+
+    from symbolicregression_jl_tpu import search_key
+    from symbolicregression_jl_tpu.evolve.step import generation_step
+    from symbolicregression_jl_tpu.ops.program import compile_program
+
+    options, ds, engine = make_bench_problem(
+        populations=I, population_size=P, ncycles_per_iteration=100,
+        tournament_selection_n=16)
+    cfg = engine.cfg
+    state = engine.init_state(search_key(0), ds.data, I)
+    for _ in range(WARM):
+        state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    print(f"warmed {WARM} iterations; sampling {NCAP} cycles")
+
+    n_binary = len(cfg.operators.binary)
+    F = ds.nfeatures
+
+    @jax.jit
+    def one_cycle(key, pops, birth, ref, stats_nf, temperature, marks):
+        def island(k, pop, b, r, m):
+            return generation_step(
+                k, pop, ds.data, stats_nf, temperature,
+                jnp.int32(options.maxsize), b, r, cfg, options,
+                engine.tables, options.elementwise_loss, marks=m,
+                return_candidates=True)
+        return jax.vmap(island)(key, pops, birth, ref, marks)
+
+    @jax.jit
+    def progify(trees):
+        flat = jax.tree.map(
+            lambda x: x.reshape((-1,) + x.shape[2:]), trees)
+        return compile_program(flat, F, n_binary)
+
+    pops, birth, ref = state.pops, state.birth, state.ref
+    marks = (jnp.zeros((I, P), jnp.bool_), jnp.zeros((I, P), jnp.bool_))
+    stats_nf = state.stats.normalized_frequencies
+    base = jax.random.fold_in(state.key, 12345)
+
+    agg = {k: [] for k in [
+        "cand_island_struct", "cand_global_struct",
+        "cand_island_full", "cand_global_full",
+        "pop_island_struct", "pop_global_struct"]}
+    group_sizes = []
+
+    for c in range(NCAP):
+        temperature = jnp.float32(1.0 - c / max(NCAP - 1, 1))
+        keys = jax.random.split(jax.random.fold_in(base, c), I)
+        pops, nev, birth, ref, marks, cand = one_cycle(
+            keys, pops, birth, ref, stats_nf, temperature, marks)
+        prog = progify(cand)
+        Tb = cand.arity.shape[1]
+        code = np.asarray(prog.code)
+        src1 = np.asarray(prog.src1)
+        src2 = np.asarray(prog.src2)
+        nst = np.asarray(prog.nsteps)
+        cv = np.asarray(prog.cvals)
+        nc = np.asarray(prog.nconst)
+
+        # global over the flat batch
+        g_s, _, counts = dup_stats(code, src1, src2, nst)
+        g_f, _, _ = dup_stats(code, src1, src2, nst, cv, nc)
+        group_sizes.append(counts)
+        # per island: mean over islands
+        i_s, i_f = [], []
+        for i in range(I):
+            s = slice(i * Tb, (i + 1) * Tb)
+            r, _, _ = dup_stats(code[s], src1[s], src2[s], nst[s])
+            rf, _, _ = dup_stats(code[s], src1[s], src2[s], nst[s],
+                                 cv[s], nc[s])
+            i_s.append(r)
+            i_f.append(rf)
+        agg["cand_island_struct"].append(float(np.mean(i_s)))
+        agg["cand_island_full"].append(float(np.mean(i_f)))
+        agg["cand_global_struct"].append(g_s)
+        agg["cand_global_full"].append(g_f)
+
+        if c in (0, NCAP - 1):
+            pprog = progify(pops.trees)
+            pc, p1, p2, pn = (np.asarray(pprog.code), np.asarray(pprog.src1),
+                              np.asarray(pprog.src2), np.asarray(pprog.nsteps))
+            pg, _, _ = dup_stats(pc, p1, p2, pn)
+            ps = []
+            for i in range(I):
+                s = slice(i * P, (i + 1) * P)
+                r, _, _ = dup_stats(pc[s], p1[s], p2[s], pn[s])
+                ps.append(r)
+            agg["pop_island_struct"].append(float(np.mean(ps)))
+            agg["pop_global_struct"].append(pg)
+
+    print(f"\nconfig: {I} islands x {P} members, eval batch/island = "
+          f"{Tb} trees, {NCAP} cycles sampled after {WARM} warm iters")
+    for k, v in agg.items():
+        if v:
+            print(f"{k:24s} mean {np.mean(v):.3f}  min {np.min(v):.3f}  "
+                  f"max {np.max(v):.3f}")
+    counts = np.concatenate(group_sizes)
+    big = counts[counts > 1]
+    if len(big):
+        print(f"global dup groups: {len(big)} groups >1, mean size "
+              f"{big.mean():.1f}, p90 {np.percentile(big, 90):.0f}, "
+              f"max {big.max()}")
+        for V in (2, 4, 8):
+            # dispatch rows if each group packs into ceil(c/V) variant rows
+            rows = np.ceil(counts / V).sum()
+            print(f"  V={V}: dispatch rows {rows / counts.sum():.2%} of "
+                  f"per-tree baseline (global packing)")
+
+
+if __name__ == "__main__":
+    main()

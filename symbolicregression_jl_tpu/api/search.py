@@ -476,7 +476,8 @@ def equation_search(
                 )
         engine = Engine(options, ds.nfeatures, dtype=_np_dtype(options.eval_dtype),
                         n_params=n_params, n_classes=n_classes,
-                        template=template, n_data_shards=ropt.n_data_shards)
+                        template=template, n_data_shards=ropt.n_data_shards,
+                        n_island_shards=n_island_shards)
         data = shard_device_data(ds.data, mesh)
         key, k_init = jax.random.split(key)
         if saved_state is not None and j < len(saved_state.device_states):

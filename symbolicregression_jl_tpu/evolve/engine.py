@@ -91,11 +91,13 @@ class Engine:
 
     def __init__(self, options: Options, nfeatures: int, dtype=jnp.float32,
                  window_size: int = 100_000, n_params: int = 0,
-                 n_classes: int = 0, template=None, n_data_shards: int = 1):
+                 n_classes: int = 0, template=None, n_data_shards: int = 1,
+                 n_island_shards: int = 1):
         self.options = options
         self.nfeatures = nfeatures
         self.dtype = dtype
         self.template = template
+        self.n_island_shards = n_island_shards
         if template is not None:
             # Template parameters ride the per-member parameter storage
             # as a flat [total_params, 1] bank.
@@ -512,17 +514,43 @@ class Engine:
 
         # ---- finalize costs on the full dataset (finalize_costs,
         # src/Population.jl:182-196; always re-eval after simplify/opt) ----
-        cost, loss, cx = jax.vmap(
-            lambda t, p: eval_cost_batch(
-                t, data, el_loss, tables, cfg.operators, cfg.parsimony,
-                member_params=p,
+        # Flattening the island axis (instead of vmapping) lets the
+        # fused path dedup the ~40-55% of members that are identical
+        # copies across the converged populations (migration/tournament
+        # clones — measured in profiling/dup_rate.py). Single-shard
+        # island layouts only: under a sharded island axis the dedup's
+        # global sorts would lower to cross-device collectives every
+        # iteration for a ~1.03-1.15x local win.
+        use_dedup = (cfg.turbo and cfg.template is None
+                     and cfg.n_params == 0 and self.n_island_shards == 1)
+        if use_dedup:
+            flat_trees = jax.tree.map(
+                lambda x: x.reshape((I * P,) + x.shape[2:]), pops.trees)
+            flat_params = pops.params.reshape(
+                (I * P,) + pops.params.shape[2:])
+            cost, loss, cx = eval_cost_batch(
+                flat_trees, data, el_loss, tables, cfg.operators,
+                cfg.parsimony, member_params=flat_params,
                 turbo=cfg.turbo, interpret=cfg.interpret,
                 loss_function=options.resolved_loss_function,
                 dim_penalty=cfg.dim_penalty,
                 wildcard_constants=cfg.wildcard_constants,
-                template=cfg.template,
+                template=cfg.template, dedup=True,
             )
-        )(pops.trees, pops.params)
+            cost, loss, cx = (cost.reshape(I, P), loss.reshape(I, P),
+                              cx.reshape(I, P))
+        else:
+            cost, loss, cx = jax.vmap(
+                lambda t, p: eval_cost_batch(
+                    t, data, el_loss, tables, cfg.operators, cfg.parsimony,
+                    member_params=p,
+                    turbo=cfg.turbo, interpret=cfg.interpret,
+                    loss_function=options.resolved_loss_function,
+                    dim_penalty=cfg.dim_penalty,
+                    wildcard_constants=cfg.wildcard_constants,
+                    template=cfg.template,
+                )
+            )(pops.trees, pops.params)
         pops = dataclasses.replace(pops, cost=cost, loss=loss, complexity=cx)
         num_evals = num_evals + I * P
 
@@ -569,9 +597,17 @@ class Engine:
             pool = jax.tree.map(
                 lambda x: x.reshape((I * topn,) + x.shape[2:]), pool
             )
+            # The one-hot float gather clamps non-finite constants; in
+            # degenerate/early populations with fewer than topn finite
+            # members, inf-cost rows would otherwise enter the pool as
+            # silently-finite genomes. Mask them out of the sampling
+            # (reference best_sub_pop only ever migrates evaluable
+            # members in practice).
+            pool_ok = jnp.isfinite(pool.cost)
             km1, km2, km3, km4 = jax.random.split(k_mig, 4)
             pops, birth = _migrate(
-                km1, pops, pool, options.fraction_replaced, birth, I, P
+                km1, pops, pool, options.fraction_replaced, birth, I, P,
+                candidate_mask=pool_ok,
             )
             if options.hof_migration:
                 hof_pool = PopulationState(
@@ -621,6 +657,15 @@ def _migrate(key, pops: PopulationState, pool: PopulationState, frac: float,
     of kCustom gathers per iteration at the bench config. Slots past the
     pack bound (beyond ~3 sigma, vanishingly rare) skip migration this
     iteration, mirroring the crossover cand2 pack's overflow rule.
+
+    Known (accepted) bias: the pack rank runs over the flattened I*P
+    axis, so when the >3-sigma truncation fires the dropped migrations
+    always come from the highest-indexed islands rather than uniformly
+    (the reference replaces the full Poisson-sampled count,
+    src/Migration.jl:20-35). At 3 sigma this triggers on <0.2% of
+    iterations and drops only the tail slots of the last island(s);
+    a per-island pack would remove the bias at the cost of I small
+    scatters.
     """
     if frac <= 0:
         return pops, birth

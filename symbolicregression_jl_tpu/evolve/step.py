@@ -354,7 +354,7 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
                     operators, parsimony, batch_idx=None, member_params=None,
                     turbo=False, interpret=False, loss_function=None,
                     dim_penalty=1000.0, wildcard_constants=True,
-                    template=None):
+                    template=None, dedup=False):
     """Batched eval_cost (src/LossFunctions.jl:193-209): (cost, loss, complexity).
 
     ``turbo`` routes through the fused Pallas eval+loss kernel (the hot
@@ -427,7 +427,7 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
             trees, X, y, w, operators, elementwise_loss,
             params=member_params if has_params else None,
             class_idx=class_idx if has_params else None,
-            interpret=interpret,
+            interpret=interpret, dedup=dedup,
         )
     else:
         params = (
@@ -535,8 +535,14 @@ def generation_step(
     elementwise_loss,
     batch_idx=None,
     marks=None,      # (simplify_mark [P], optimize_mark [P]) bools or None
+    return_candidates=False,
 ) -> Tuple[PopulationState, jax.Array, jax.Array, jax.Array]:
     """Returns (new_pop, num_evals, new_birth0, new_ref0[, new_marks]).
+
+    ``return_candidates`` appends the flat evaluated candidate TreeBatch
+    to the return tuple — instrumentation for measuring structural
+    duplication in the eval batch (profiling/dup_rate.py); unused
+    outputs are DCE'd by jit so the default path is unaffected.
 
     ``marks`` track members whose sampled mutation kind was `simplify` or
     `optimize`. The reference applies those operations inline inside
@@ -758,6 +764,7 @@ def generation_step(
             lambda a, b: jnp.concatenate([a, b], axis=0), cand1, cand2_sel
         )  # [B + k2, ...]
         packed_params = jnp.concatenate([cand1_params, params2_sel], axis=0)
+        eval_batch = packed
         c_all, l_all, x_all = _eval(packed, packed_params)
         inf = jnp.asarray(jnp.inf, c_all.dtype)
 
@@ -782,6 +789,7 @@ def generation_step(
     else:
         if k2 == 0:
             # crossover disabled: cand2 is never consulted
+            eval_batch = cand1
             cost1, loss1, cx1 = _eval(cand1, cand1_params)
             inf = jnp.asarray(jnp.inf, cost1.dtype)
             cost = jnp.stack([cost1, jnp.full((B,), inf)], axis=1)
@@ -794,6 +802,8 @@ def generation_step(
                 lambda a, b: jnp.stack([a, b], axis=1), cand1, cand2
             )  # [B, 2, ...]
             both_params = jnp.stack([cand1_params, cand2_params], axis=1)
+            eval_batch = jax.tree.map(
+                lambda x: x.reshape((2 * B,) + x.shape[2:]), both)
             cost, loss, complexity = _eval(both, both_params)
     needs_eval = jnp.stack([needs_eval1, needs_eval2], axis=1)
     num_evals = jnp.sum(needs_eval.astype(jnp.float32))
@@ -837,17 +847,32 @@ def generation_step(
     # the stored member stays invalid-on-eval exactly like its parent
     # (whose cost, carried below, is already inf).
     m1_params = m1_all.params
-    badflag = ~jnp.all(
-        jnp.isfinite(pop.trees.const.reshape(P, -1)), axis=1
-    ) | ~jnp.all(jnp.isfinite(pop.params.reshape(P, -1)), axis=1)
-    slot_bad1 = jnp.take(badflag, i1)                       # [B]
+    # Non-finiteness only matters where eval actually reads it (const at
+    # live LEAF_CONST leaves — ops/eval.py:91, ops/program.py const_ok —
+    # and the param bank), so the bad flag and the NaN plant are both
+    # restricted to those lanes: planting only in slot 0 was ignored
+    # whenever slot 0 held a VAR/PARAM leaf, letting the clamped genome
+    # re-enter with a finite cost at the iteration boundary.
+    lane = jnp.arange(pop.trees.const.shape[-1])
+    cleaf = ((pop.trees.arity == 0) & (pop.trees.op == LEAF_CONST)
+             & (lane < pop.trees.length[..., None]))
+    bad_const = jnp.any(
+        (cleaf & ~jnp.isfinite(pop.trees.const)).reshape(P, -1), axis=1)
+    bad_params = ~jnp.all(jnp.isfinite(pop.params.reshape(P, -1)), axis=1)
+    slot_bad1 = jnp.take(bad_const | bad_params, i1)        # [B]
     fb_trees = m1_all.trees
-    nan_mark = slot_bad1[:, None] & (
-        jnp.arange(fb_trees.const.shape[-1]) == 0)
-    if fb_trees.const.ndim == 3:                            # template [B,K,L]
-        nan_mark = nan_mark[:, None, :]
+    fb_cleaf = (fb_trees.arity == 0) & (fb_trees.op == LEAF_CONST)
+    nan_mark = (
+        slot_bad1.reshape((-1,) + (1,) * (fb_trees.const.ndim - 1))
+        & fb_cleaf)
     fb_trees = dataclasses.replace(
         fb_trees, const=jnp.where(nan_mark, jnp.nan, fb_trees.const))
+    # When the parent's non-finiteness lived in its params, the clamped
+    # param bank needs the same invalid marker.
+    bad_p1 = jnp.take(bad_params, i1)
+    m1_params = jnp.where(
+        bad_p1.reshape((-1,) + (1,) * (m1_params.ndim - 1)),
+        jnp.nan, m1_params)
     accept1 = accepted_mut & ~immediate
     baby1_tree = M._select_tree(accept1, cand1, fb_trees)
     baby1_params = jnp.where(
@@ -915,6 +940,8 @@ def generation_step(
         ),
     )
     if marks is None:
+        if return_candidates:
+            return new_pop, num_evals, birth0 + nb, ref0 + nb, eval_batch
         return new_pop, num_evals, birth0 + nb, ref0 + nb
     # Deferred simplify/optimize marks ride the replacement scatter: the
     # surviving copy of the member carries the flag; replaced slots that
@@ -930,6 +957,8 @@ def generation_step(
         scatter(simp_mark, simp_flags),
         scatter(opt_mark, opt_flags),
     )
+    if return_candidates:
+        return new_pop, num_evals, birth0 + nb, ref0 + nb, new_marks, eval_batch
     return new_pop, num_evals, birth0 + nb, ref0 + nb, new_marks
 
 

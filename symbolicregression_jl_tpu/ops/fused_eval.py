@@ -41,6 +41,7 @@ from .operators import OperatorSet
 from .program import TreeProgram, compile_program
 
 __all__ = ["fused_loss", "fused_loss_program", "fused_loss_multi",
+           "fused_loss_dedup",
            "fused_grad_program", "fused_grad_multi",
            "fused_loss_and_const_grad", "fused_predict",
            "fused_predict_program", "fused_predict_ad",
@@ -339,8 +340,9 @@ def _check_packable(operators: OperatorSet, base: int, max_steps: int) -> None:
     plan = _dispatch_plan(operators)
     if base + max_steps + _zero_rows(operators) > 4096:
         raise ValueError(
-            f"Buffer address space {base + max_steps + 1} exceeds the packed "
-            f"12-bit operand field (nfeatures + cmax + max_nodes <= 4096)."
+            f"Buffer address space {base + max_steps + _zero_rows(operators)} "
+            f"exceeds the packed 12-bit operand field "
+            f"(nfeatures + cmax + max_nodes <= 4096)."
         )
     if plan.merged and plan.n_branches > 63:
         raise ValueError(
@@ -655,7 +657,7 @@ def _make_multi_kernel(
     jax.jit,
     static_argnames=(
         "nfeatures", "operators", "loss_fn", "tree_block", "bf16",
-        "interpret",
+        "interpret", "tile_budget",
     ),
 )
 def fused_loss_multi(
@@ -671,6 +673,7 @@ def fused_loss_multi(
     tree_block: int = 8,
     bf16: bool = False,
     interpret: bool = False,
+    tile_budget: int = 8 * 2**20,
 ) -> Tuple[jax.Array, jax.Array]:
     """Mean loss for every (tree, constant-variant) pair: [T, V] each.
 
@@ -711,7 +714,7 @@ def fused_loss_multi(
             fused_loss_multi(
                 prog, cvals_v[:, v0:v0 + VCH], X, y, weights, nfeatures,
                 operators, loss_fn, tree_block=tree_block, bf16=bf16,
-                interpret=interpret)
+                interpret=interpret, tile_budget=tile_budget)
             for v0 in range(0, V, VCH)
         ]
         return (jnp.concatenate([o[0] for o in outs], axis=1),
@@ -722,7 +725,7 @@ def fused_loss_multi(
     # bf16 tiles the (V, TILE) plane in (16, 128) blocks — size VMEM by
     # the sublane-padded variant count.
     V_phys = _round_up(V, 16) if bf16 else V
-    TILE = _pick_tile(n, n, rows * V_phys, bytes_per, budget=8 * 2**20)
+    TILE = _pick_tile(n, n, rows * V_phys, bytes_per, budget=tile_budget)
     T_pad = _round_up(T, TB)
     n_pad = _round_up(n, TILE)
 
@@ -787,6 +790,149 @@ def fused_loss_multi(
     loss = loss_sum / denom
     loss = jnp.where(valid & jnp.isfinite(loss), loss, jnp.inf)
     return loss, valid
+
+
+# ---------------------------------------------------------------------------
+# Identical-program dedup: evaluate each unique (structure, constants) once
+# ---------------------------------------------------------------------------
+#
+# Evolved candidate batches repeat programs heavily (tournament
+# re-picks, kept-parent fallbacks, migration copies, converged
+# populations): profiling/dup_rate.py measures ~50% duplicate
+# (code, src1, src2, nsteps) rows and ~33% FULLY identical rows
+# (constants included) across the bench config's flattened per-cycle
+# eval batch. Fully identical rows produce bit-identical losses, so
+# only group leaders need to execute: duplicates degenerate to 1-step
+# programs and copy the leader's (loss, valid) via a segment
+# fill-forward scan. No compaction — the row count stays T (static
+# shapes), only dispatch/vector work shrinks.
+#
+# (A variants-axis packing of structure-only duplicates through
+# `fused_loss_multi` was built and measured first: the multi kernel's
+# per-variant marginal cost is ~41% of a full dispatch stream at
+# TILE=10k — V=4 packing LOSES on the ~80% of rows that are unique.
+# Full-identity dedup has zero per-row overhead and is exact.)
+
+# Fixed odd multipliers for the 3 independent linear hashes (int32
+# wraparound math; hash collisions only affect sort adjacency — the
+# grouping below is exact-verified on the sorted rows).
+_HASH_R = np.random.default_rng(0xC0FFEE).integers(
+    1, 2**31, size=(3, 4096), dtype=np.int64).astype(np.int32) | 1
+
+
+def _sort_rows_by(keys3, payloads, width):
+    """Stable-sort [T, width] payload rows by three [T] int32 keys.
+
+    Broadcasting the keys across the row axis and sorting along axis 0
+    permutes every column identically (stable sort, equal keys per
+    column) — the TPU-friendly way to co-permute rows without a
+    serialized gather."""
+    ops = [jnp.broadcast_to(k[:, None], (k.shape[0], width))
+           for k in keys3] + list(payloads)
+    out = jax.lax.sort(ops, dimension=0, num_keys=3, is_stable=True)
+    return out[3:]
+
+
+def _fill_forward_segments(start, values):
+    """Propagate each segment leader's values to the whole segment.
+
+    ``start`` [T] bool marks segment starts in sorted order; ``values``
+    is a pytree of [T] arrays whose entries are meaningful at starts.
+    Associative "last leader wins" scan — no gathers."""
+    def combine(a, b):
+        a_vals, a_start = a
+        b_vals, b_start = b
+        vals = jax.tree.map(
+            lambda av, bv: jnp.where(b_start, bv, av), a_vals, b_vals)
+        return vals, a_start | b_start
+    out, _ = jax.lax.associative_scan(combine, (values, start))
+    return out
+
+
+def fused_loss_dedup(
+    prog: TreeProgram,          # flat [T, L] program
+    X: jax.Array,               # [F, n]
+    y: jax.Array,               # [n]
+    weights: Optional[jax.Array],
+    nfeatures: int,
+    operators: OperatorSet,
+    loss_fn: Callable,
+    *,
+    tree_block: int = 16,
+    tile_rows: int = 16384,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """`fused_loss_program` semantics, executing each unique program once.
+
+    Returns (loss [T], valid [T]) in the original row order, bit-equal
+    to the plain path (leaders run the identical kernel; duplicates
+    copy the leader's result). f32, non-parametric programs only (the
+    caller gates).
+    """
+    T, L = prog.code.shape
+    CMAX = prog.cmax
+    step = jnp.arange(L, dtype=jnp.int32)[None, :]
+    live = step < prog.nsteps[:, None]
+    # One word encodes a step exactly (code < 128, addresses < 4096);
+    # padding steps are zeroed so residual leaf-address content can't
+    # split groups.
+    word = jnp.where(
+        live, (prog.code << 24) | (prog.src1 << 12) | prog.src2, 0)
+    cbits = jax.lax.bitcast_convert_type(
+        prog.cvals.astype(jnp.float32), jnp.int32)
+    cused = jnp.arange(CMAX, dtype=jnp.int32)[None, :] < prog.nconst[:, None]
+    cbits = jnp.where(cused, cbits, 0)
+
+    R = jnp.asarray(_HASH_R[:, :L])
+    Rc = jnp.asarray(_HASH_R[:, L:L + CMAX])
+    S = jnp.asarray(_HASH_R[:, L + CMAX:L + CMAX + 1])
+    h = [jnp.sum(word * R[k][None, :], axis=1)
+         + jnp.sum(cbits * Rc[k][None, :], axis=1)
+         + prog.nsteps * S[k, 0]
+         for k in range(3)]
+
+    word_s, = _sort_rows_by(h, [word], L)
+    cbits_s, = _sort_rows_by(h, [cbits], CMAX)
+    scal = _sort_rows_by(
+        h, [prog.nsteps[:, None], prog.nconst[:, None],
+            prog.const_ok.astype(jnp.int32)[:, None],
+            jnp.arange(T, dtype=jnp.int32)[:, None]], 1)
+    nsteps_s = scal[0][:, 0]
+    nconst_s = scal[1][:, 0]
+    ok_s = scal[2][:, 0]
+    orig_s = scal[3][:, 0]
+    cvals_s = jax.lax.bitcast_convert_type(
+        cbits_s, jnp.float32).astype(prog.cvals.dtype)
+
+    # Exact grouping on the sorted neighbors (hash only drives adjacency).
+    prev = lambda x: jnp.concatenate([x[:1], x[:-1]], axis=0)
+    eq = (jnp.all(word_s == prev(word_s), axis=1)
+          & jnp.all(cbits_s == prev(cbits_s), axis=1)
+          & (nsteps_s == prev(nsteps_s)))
+    eq = eq.at[0].set(False)
+    start = ~eq
+
+    prog_s = TreeProgram(
+        code=(word_s >> 24) & 0x7F,
+        src1=(word_s >> 12) & 0xFFF,
+        src2=word_s & 0xFFF,
+        nsteps=jnp.where(start, nsteps_s, 1),    # duplicates: 1 cheap step
+        cvals=cvals_s,
+        cslot=jnp.zeros((T, CMAX), jnp.int32),   # unused by this kernel
+        nconst=jnp.where(start, nconst_s, 0),
+        const_ok=(ok_s == 1) | ~start,
+    )
+    loss_s, valid_s = fused_loss_program(
+        prog_s, X, y, weights, nfeatures, operators, loss_fn,
+        tree_block=tree_block, tile_rows=tile_rows, interpret=interpret)
+
+    loss_f, valid_f = _fill_forward_segments(
+        start, (loss_s, valid_s.astype(jnp.int32)))
+
+    # Un-permute to the original row order (sort by original index).
+    _, loss_o, valid_o = jax.lax.sort(
+        [orig_s, loss_f, valid_f], dimension=0, num_keys=1, is_stable=True)
+    return loss_o, valid_o.astype(jnp.bool_)
 
 
 # ---------------------------------------------------------------------------
@@ -1071,6 +1217,7 @@ def fused_grad_program(
     jax.jit,
     static_argnames=(
         "operators", "loss_fn", "tree_block", "tile_rows", "interpret",
+        "dedup",
     ),
 )
 def fused_loss(
@@ -1086,8 +1233,15 @@ def fused_loss(
     tree_block: int = 8,
     tile_rows: int = 16384,
     interpret: bool = False,
+    dedup: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Mean elementwise loss per tree, fused on TPU.
+
+    ``dedup``: evaluate each fully identical (structure, constants)
+    program once and share the result (bit-equal; see
+    `fused_loss_dedup`). Worth it for large flat batches with repeated
+    members (the finalize eval over whole converged populations);
+    ignored for parametric batches.
 
     Returns ``(loss[...], valid[...])`` with the TreeBatch's batch dims;
     invalid trees get loss=inf (matching aggregate_loss semantics).
@@ -1112,11 +1266,19 @@ def fused_loss(
         p_flat = params.reshape(-1, NP, NC)
         class_oh = (class_idx[None, :] == jnp.arange(NC)[:, None]).astype(
             X.dtype)
-    loss, valid = fused_loss_program(
-        prog, X, y, weights, F, operators, loss_fn,
-        params=p_flat, class_oh=class_oh,
-        tree_block=tree_block, tile_rows=tile_rows, interpret=interpret,
-    )
+    # dedup groups constants through a float32 bitcast — gate on f32 so
+    # f64 runs never merge members distinct only below f32 resolution.
+    if dedup and NP == 0 and prog.cvals.dtype == jnp.float32:
+        loss, valid = fused_loss_dedup(
+            prog, X, y, weights, F, operators, loss_fn,
+            tree_block=tree_block, tile_rows=tile_rows, interpret=interpret,
+        )
+    else:
+        loss, valid = fused_loss_program(
+            prog, X, y, weights, F, operators, loss_fn,
+            params=p_flat, class_oh=class_oh,
+            tree_block=tree_block, tile_rows=tile_rows, interpret=interpret,
+        )
     if NP > 0:
         # const_ok analogue for the parameter region: a non-finite bank
         # value absorbed by an op (exp(-inf) = 0) would otherwise pass

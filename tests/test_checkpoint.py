@@ -43,6 +43,7 @@ def _options(tmp_path, **kw):
     return Options(**base)
 
 
+@pytest.mark.slow
 def test_checkpoint_write_and_resume(tmp_path):
     X, y = _problem()
     options = _options(tmp_path)
@@ -108,6 +109,7 @@ def test_save_load_roundtrip_preserves_state(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_resume_num_evals_not_double_counted(tmp_path):
     # fresh 2-iteration run vs (1 iteration -> resume -> 1 iteration):
     # identical seed => identical totals; double-counting would inflate

@@ -40,6 +40,7 @@ def test_shard_device_data_places_rows_on_data_axis():
     assert data.y.sharding.spec[0] == DATA_AXIS
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n_data_shards", [2, 4])
 def test_search_with_data_sharding(n_data_shards):
     X, y = _problem()
@@ -64,6 +65,7 @@ def test_search_with_data_sharding(n_data_shards):
     assert best < 2.0  # search made real progress under row sharding
 
 
+@pytest.mark.slow
 def test_sharded_matches_unsharded_loss():
     # Same seed, 1 vs 2 data shards: losses must agree (the psum
     # reduction is exact up to float reassociation).

@@ -38,6 +38,7 @@ def test_deterministic_requires_seed():
         equation_search(X, y, options=_options(), niterations=1, verbosity=0)
 
 
+@pytest.mark.slow
 def test_two_deterministic_runs_identical():
     X, y = _problem()
     hofs = []

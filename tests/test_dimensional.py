@@ -193,6 +193,7 @@ class TestDimensionalConstraints:
 
 
 class TestSearchWithUnits:
+    @pytest.mark.slow
     def test_search_respects_units(self):
         # y = x1/x2 with units m, s -> m/s; the penalty should steer the
         # search to unit-consistent expressions.

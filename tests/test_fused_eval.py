@@ -111,6 +111,68 @@ def test_fused_batch_dims(setup):
     )
 
 
+def test_fused_loss_dedup_bit_equal(setup):
+    """Identical-program dedup must be BIT-equal to the plain path:
+    duplicates copy their leader's result, structure-only duplicates
+    (same shape, different constants) must NOT merge."""
+    import dataclasses as dc
+
+    opts, cfg, X, y = setup
+    rng = np.random.default_rng(4)
+    base = init_population(jax.random.PRNGKey(17), 48, cfg.mctx, jnp.float32)
+    # Build a batch with heavy duplication: 3 copies of each member in a
+    # shuffled order; one copy of each gets its constants perturbed
+    # (structure dup, full non-dup).
+    pert = dc.replace(
+        base,
+        const=base.const * jnp.asarray(
+            1.0 + 0.3 * rng.normal(size=base.const.shape).astype(np.float32)),
+    )
+    cat = jax.tree.map(
+        lambda a, b, c: jnp.concatenate([a, b, c], axis=0), base, base, pert)
+    perm = jnp.asarray(rng.permutation(3 * 48))
+    batch = jax.tree.map(lambda x: jnp.take(x, perm, axis=0), cat)
+
+    l_plain, v_plain = fused_loss(
+        batch, X, y, None, cfg.operators, l2_dist_loss, interpret=True)
+    l_dedup, v_dedup = fused_loss(
+        batch, X, y, None, cfg.operators, l2_dist_loss, interpret=True,
+        dedup=True)
+    lp, ld = np.asarray(l_plain), np.asarray(l_dedup)
+    assert np.array_equal(np.asarray(v_plain), np.asarray(v_dedup))
+    assert np.array_equal(np.isfinite(lp), np.isfinite(ld))
+    assert np.array_equal(lp[np.isfinite(lp)], ld[np.isfinite(ld)])
+
+
+def test_fused_loss_dedup_nonfinite_constants(setup):
+    """A member with a non-finite constant stays invalid through dedup,
+    and does not poison distinct members that share its structure."""
+    opts, cfg, X, y = setup
+    opset = cfg.operators
+    exprs = [
+        sr.parse_expression("2.0 * x1 + 1.0", opset),
+        sr.parse_expression("2.0 * x1 + 1.0", opset),   # exact duplicate
+        sr.parse_expression("3.0 * x1 + 1.0", opset),   # structure dup only
+        sr.parse_expression("x2", opset),
+    ]
+    import dataclasses as dc
+    batch = encode_population(exprs, opts.maxsize, opset)
+    # poison every const leaf of member 0
+    cleaf0 = (batch.arity[0] == 0) & (batch.op[0] == 0)  # LEAF_CONST
+    const = batch.const.at[0].set(
+        jnp.where(cleaf0, jnp.inf, batch.const[0]))
+    bad = dc.replace(batch, const=const)
+    l, v = fused_loss(bad, X, y, None, opset, l2_dist_loss, interpret=True,
+                      dedup=True)
+    l2, v2 = fused_loss(bad, X, y, None, opset, l2_dist_loss, interpret=True)
+    assert np.array_equal(np.asarray(v), np.asarray(v2))
+    assert not bool(v[0])
+    assert bool(v[1]) and bool(v[2]) and bool(v[3])
+    assert np.isinf(float(l[0]))
+    fin = np.isfinite(np.asarray(l2))
+    assert np.array_equal(np.asarray(l)[fin], np.asarray(l2)[fin])
+
+
 def test_fused_loss_multi_matches_replication(setup):
     """The multi-variant kernel == fused_loss on per-variant replicas
     (the line-search fast path must not change any loss value)."""

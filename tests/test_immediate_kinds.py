@@ -7,6 +7,7 @@ iteration boundary (see generation_step's docstring).
 """
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -67,6 +68,7 @@ def test_simplify_kind_folds_marked_members():
     assert (lengths == 3).sum() >= 6, lengths
 
 
+@pytest.mark.slow
 def test_optimize_kind_tunes_constants():
     X, y = _mk_data()
     opts = Options(

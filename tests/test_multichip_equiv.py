@@ -12,6 +12,7 @@ different layouts, which moves optimizer arithmetic by ~1 ULP.
 """
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -59,6 +60,7 @@ def _run(n_island_shards: int):
     return jax.device_get(state)
 
 
+@pytest.mark.slow
 def test_island_sharding_is_bit_exact():
     assert len(jax.devices()) == 8, "conftest virtual mesh not engaged"
     s1 = _run(1)

@@ -56,6 +56,7 @@ def test_parameter_string_and_parse_roundtrip(ops):
     assert back == tree
 
 
+@pytest.mark.slow
 def test_parametric_search_recovers_per_class_offsets():
     rng = np.random.default_rng(0)
     n = 128
@@ -99,6 +100,7 @@ def test_parametric_search_requires_class_column():
         equation_search(X, y, options=opts, niterations=1, verbosity=0)
 
 
+@pytest.mark.slow
 def test_parametric_regressor_fit_predict():
     from symbolicregression_jl_tpu.api.regressor import SRRegressor
 
@@ -266,6 +268,7 @@ def test_fused_parametric_loss_matches_interpreter(ops):
         np.asarray(l_ref)[ok], np.asarray(l_fused)[ok], rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_parametric_search_with_turbo_recovers():
     """Full parametric search on the fused eval path (turbo=True)."""
     rng = np.random.default_rng(1)

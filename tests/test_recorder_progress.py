@@ -5,6 +5,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from symbolicregression_jl_tpu import Options, equation_search
 
@@ -31,6 +32,7 @@ def _options(tmp_path, **kw):
     )
 
 
+@pytest.mark.slow
 def test_recorder_writes_genealogy(tmp_path):
     X, y = _problem()
     options = _options(tmp_path, use_recorder=True, recorder_file="rec.json")

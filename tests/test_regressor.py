@@ -38,6 +38,7 @@ def problem():
     return X, y
 
 
+@pytest.mark.slow
 def test_fit_predict_score(problem):
     X, y = problem
     model = SRRegressor(niterations=3, seed=0, **_opts())
@@ -80,6 +81,7 @@ def test_warm_start_refit_continues(problem):
     assert model.get_best().loss <= loss1 + 1e-6
 
 
+@pytest.mark.slow
 def test_multitarget(problem):
     X, _ = problem
     Y = np.stack([2.0 * X[:, 0], X[:, 1] + 1.0], axis=1)  # (n, 2)
@@ -136,6 +138,7 @@ def test_dataframe_inputs_and_column_names(problem):
     np.testing.assert_allclose(pred, pred_dict)
 
 
+@pytest.mark.slow
 def test_units_echo_through_predict(problem):
     """y_units given at fit echo on predictions with with_units=True —
     the reference's unit-typed predict round-trip."""

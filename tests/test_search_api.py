@@ -51,6 +51,7 @@ def linear_problem():
     return X, y
 
 
+@pytest.mark.slow
 def test_search_improves_over_baseline(linear_problem):
     X, y = linear_problem
     hof = equation_search(
@@ -74,6 +75,7 @@ def test_search_early_stop(linear_problem):
     assert len(hof.entries) >= 1
 
 
+@pytest.mark.slow
 def test_search_return_state_and_warm_start(linear_problem):
     X, y = linear_problem
     opts = small_options()
@@ -183,6 +185,7 @@ def test_cur_maxsize_warmup():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8_devices():
     import jax
 

@@ -7,6 +7,7 @@ import io
 import time
 
 import numpy as np
+import pytest
 
 from symbolicregression_jl_tpu import Options, equation_search
 from symbolicregression_jl_tpu.api.search import RuntimeOptions
@@ -49,6 +50,7 @@ def test_watcher_inactive_on_non_tty():
     assert not w.check()
 
 
+@pytest.mark.slow
 def test_user_quit_stops_search(capsys):
     X, y = _problem()
     hof = equation_search(
@@ -77,6 +79,7 @@ def test_timeout_checked_mid_iteration():
     assert time.time() - t0 < 120
 
 
+@pytest.mark.slow
 def test_chunked_iteration_bit_identical():
     """Chunked and single-launch iterations must produce identical
     results: global cycle indices drive the annealing ramp and RNG
@@ -115,6 +118,7 @@ def test_chunked_iteration_bit_identical():
     )
 
 
+@pytest.mark.slow
 def test_default_search_is_chunked(monkeypatch):
     """Stop checks run mid-iteration EVEN WITHOUT a configured budget:
     the evolve phase is always chunked (adaptive count, ~1 s stop

@@ -210,6 +210,7 @@ def test_template_nested_composition_eval(ops):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_template_search_recovers_structured_law():
     spec = template_spec(expressions=("f", "g"))(
         lambda f, g, x1, x2, x3: f(x1, x2) + g(x3)
@@ -244,6 +245,7 @@ def test_template_search_recovers_structured_law():
             assert all(f < st.num_features[k] for f in feats)
 
 
+@pytest.mark.slow
 def test_template_search_with_parameters_recovers_exact():
     spec = template_spec(expressions=("f",), parameters={"p": 2})(
         lambda f, x1, x2, p: f(x1) + p[0] * x2 + p[1]
@@ -315,6 +317,7 @@ def test_parse_template_expression_roundtrip(ops):
     np.testing.assert_allclose(pred, expect, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_template_guess_seeding_injects_solution():
     spec = template_spec(expressions=("f", "g"))(
         lambda f, g, x1, x2, x3: f(x1, x2) + g(x3)
@@ -359,6 +362,7 @@ def test_parse_template_params_omitted_or_partial(ops):
         parse_template_expression("f = #1; p = [1, 2]", st, ops)
 
 
+@pytest.mark.slow
 def test_template_dict_guess_with_params_and_validation():
     spec = template_spec(expressions=("f",), parameters={"p": 1})(
         lambda f, x1, x2, p: f(x1) + p[0] * x2
@@ -420,6 +424,7 @@ def test_eval_template_batch_fused_matches_unfused(ops):
     assert bool(v1[0]) and not bool(v1[1])
 
 
+@pytest.mark.slow
 def test_template_search_fused_path_runs():
     """Force turbo on CPU (interpret kernels) through a short template
     search to cover the fused engine path end-to-end."""
@@ -523,6 +528,7 @@ def test_template_latex_export(ops):
     assert "\\cos" in tex
 
 
+@pytest.mark.slow
 def test_fused_template_gradients_match_interpreter(ops):
     """Gradient parity of fused_predict_ad's hand-written VJP kernel vs
     jax.grad through the interpreter path — the load-bearing piece of the
@@ -672,6 +678,7 @@ def test_D_host_composable_symbolic(ops):
     )
 
 
+@pytest.mark.slow
 def test_template_search_recovers_force_law():
     """Physics idiom: fit force = -D(V, 1)(x) and recover the potential's
     derivative matching y = -3x (V ~ 1.5 x^2 + const)."""

@@ -181,6 +181,7 @@ class TestDeviceFold:
             jax.random.PRNGKey(seed), n, mctx, jnp.float32)
         return ops, trees
 
+    @pytest.mark.slow
     def test_fold_eval_equivalence_and_idempotence(self):
         import jax
         import jax.numpy as jnp
