@@ -1,30 +1,42 @@
-"""Search-QUALITY benchmark: loss-vs-wall-clock Pareto fronts, TPU vs CPU.
+"""Search-QUALITY benchmark: wall-clock-to-quality, honest comparator.
 
 Throughput (bench.py) says how fast evals run; this harness asks whether
-the searches *find equally good equations per unit wall-clock*. It runs
-the same engine (same algorithm, same options) on the TPU backend
-(turbo Pallas kernels) and on the multithreaded XLA CPU backend (jnp
-interpreter path — the measured-CPU reference point from
-profiling/cpu_baseline.py / BASELINE.md), over:
+the search *finds equally good equations per unit wall-clock*. Three
+legs per (problem, seed):
 
-- the reference benchmark problem
-  (/root/reference/benchmark/benchmarks.jl:11-33: n=1000 rows, 5
-  features, ops {+,-,*,/} ∪ {exp,abs}, maxsize=30, target
-  cos(2.13x₁)+0.5x₂|x₃|^0.9−0.3|x₄|^1.5 + 0.1·noise), and
-- a 10-problem Feynman-style suite (2-5 variables, physics forms).
+- ``refproxy`` — the rate-matched reference stand-in (round-3 verdict
+  item 1a). Julia is not installed here, so the reference cannot be
+  run directly; BASELINE.md's measured CPU rate (8,097 evals/s/core ->
+  ~6.5e4 evals/s for an 8-core multithreaded host, measured by
+  profiling/cpu_baseline.py on this host's cores) anchors a proxy: the
+  SAME algorithm at the reference's own config (populations=31,
+  population_size=27, ncycles=380 — /root/reference/src/Options.jl:
+  1161-1208) is given an eval budget of 6.5e4 x wall_budget and its
+  curve is recorded against VIRTUAL wall-clock = cum_evals / 6.5e4.
+  This replaces round 3's XLA-CPU leg, which ran 50-100x slower than
+  the real reference and made the comparison a strawman. Caveat
+  (documented, unavoidable): the proxy executes THIS engine's
+  bulk-synchronous variant of the algorithm, not the reference's exact
+  async scheduler — quality-per-eval was validated distributionally
+  equal across backends in rounds 2-3.
+- ``tpu31`` — this engine at the reference's config, REAL wall-clock.
+  Honest matched-config comparison; at 31x27 the chip idles
+  (~36k evals/s) and this leg is expected to lose to the proxy.
+- ``tpunative`` — the TPU-native config (populations=512,
+  population_size=256, ncycles=100 — profiling/config_sweep.py), REAL
+  wall-clock, iterations chunked so the budget is actually respected
+  (round-3 verdict weak #5: a "budget" that admits one 343 s iteration
+  is not a budget).
 
-Each run gets a fixed wall-clock budget (compile excluded via one warmup
-iteration at identical shapes) and N seeds; after every iteration the
-harness records (elapsed, best_loss, pareto front). Results aggregate to
-``profiling/quality_results.json``; BASELINE.md summarizes.
+Summary adds wall-clock-to-target ratios (verdict item 1c): per seed,
+target = the proxy's final best loss; speedup = proxy virtual budget /
+tpunative's real time to reach the target (within 5%, or SOLVED).
 
 Usage:
-  python profiling/quality_bench.py --run PROBLEM PLATFORM SEED BUDGET
-      (single run; prints one JSON line — used via subprocess so each
-       run gets a fresh process pinned to its backend)
-  python profiling/quality_bench.py --suite [--budget-bench 60]
-      [--budget-feynman 40] [--seeds-bench 4] [--seeds-feynman 2]
-      (full matrix -> profiling/quality_results.json)
+  python profiling/quality_bench.py --run PROBLEM LEG SEED BUDGET
+  python profiling/quality_bench.py --suite [--budget-bench 75]
+      [--budget-feynman 45] [--seeds-bench 3] [--seeds-feynman 2]
+  python profiling/quality_bench.py --repair
 """
 
 from __future__ import annotations
@@ -40,6 +52,13 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
+
+# Measured: profiling/cpu_baseline.py (8,097 evals/s/core on this host,
+# transcendental-bound numpy per-node evaluator) x 8 cores. See
+# BASELINE.md "Measured CPU baseline".
+REF_RATE = 6.5e4
+
+LEGS = ("refproxy", "tpu31", "tpunative")
 
 DEFAULT_OPS = dict(binary_operators=["+", "-", "*", "/"],
                    unary_operators=["exp", "abs"])
@@ -83,13 +102,51 @@ def _feynman_problem(name, rng):
     return X, y, FEYNMAN_OPS
 
 
-def single_run(problem: str, platform: str, seed: int, budget_s: float):
+# Real Feynman-benchmark equations WITH SI units (round-4 verdict item 6:
+# dimensional analysis through the full pipeline — ops/dims_eval.py +
+# core/units.py in anger, /root/reference/src/DimensionalAnalysis.jl:
+# 223-275). (X_units, y_unit, fn, range); Feynman numbering in comments.
+FEYNMAN_SI = {
+    # I.12.2  F = q1 q2 / (4 pi eps r^2)
+    "si_coulomb": ((["A*s", "A*s", "kg^-1*m^-3*s^4*A^2", "m"], "kg*m*s^-2",
+                    lambda x: x[0] * x[1] / (4 * np.pi * x[2] * x[3] ** 2),
+                    (0.5, 2.0))),
+    # I.14.3  U = m g z
+    "si_grav_pe": ((["kg", "m/s^2", "m"], "kg*m^2/s^2",
+                    lambda x: x[0] * x[1] * x[2], (0.5, 2.0))),
+    # I.29.4  k = omega / c
+    "si_wavenum": ((["1/s", "m/s"], "1/m",
+                    lambda x: x[0] / x[1], (0.5, 2.0))),
+    # I.39.1  E = 3/2 p V
+    "si_gas_energy": ((["kg*m^-1*s^-2", "m^3"], "kg*m^2/s^2",
+                       lambda x: 1.5 * x[0] * x[1], (0.5, 2.0))),
+    # I.34.8  omega = q v B / p
+    "si_cyclotron": ((["A*s", "m/s", "kg*A^-1*s^-2", "kg*m/s"], "1/s",
+                     lambda x: x[0] * x[1] * x[2] / x[3], (0.5, 2.0))),
+    # II.3.24 h = P / (4 pi r^2)
+    "si_flux": ((["kg*m^2*s^-3", "m"], "kg/s^3",
+                 lambda x: x[0] / (4 * np.pi * x[1] ** 2), (0.5, 2.0))),
+    # I.18.12 tau = r F sin(theta)
+    "si_torque": ((["m", "kg*m/s^2", ""], "kg*m^2/s^2",
+                   lambda x: x[0] * x[1] * np.sin(x[2]), (0.3, 1.5))),
+    # I.25.13 V = q / C
+    "si_capacitor": ((["A*s", "kg^-1*m^-2*s^4*A^2"], "kg*m^2*A^-1*s^-3",
+                      lambda x: x[0] / x[1], (0.5, 2.0))),
+}
+
+
+def _feynman_si_problem(name, rng):
+    x_units, y_unit, fn, (lo, hi) = FEYNMAN_SI[name]
+    nv = len(x_units)
+    X = rng.uniform(lo, hi, (1000, nv)).astype(np.float32)
+    y = fn(X.T).astype(np.float32)
+    ops = dict(binary_operators=["+", "-", "*", "/"],
+               unary_operators=["sin", "sqrt"])
+    return X, y, ops, x_units, y_unit
+
+
+def single_run(problem: str, leg: str, seed: int, budget_s: float):
     import jax
-    if platform == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    # persistent compile cache: seeds/problems share executables, so the
-    # per-subprocess compile cost amortizes across the suite (per-user
-    # path — a world-shared one breaks on multi-user hosts)
     cache = os.path.join(
         tempfile.gettempdir(), f"jax_quality_cache_{os.getuid()}")
     jax.config.update("jax_compilation_cache_dir", cache)
@@ -99,39 +156,73 @@ def single_run(problem: str, platform: str, seed: int, budget_s: float):
     from symbolicregression_jl_tpu.core.dataset import make_dataset
     from symbolicregression_jl_tpu.evolve.engine import Engine
 
-    rng = np.random.default_rng(1234)  # same data for every seed/platform
+    rng = np.random.default_rng(1234)  # same data for every seed/leg
+    x_units = y_unit = None
     if problem == "bench":
         X, y, ops = _bench_problem(rng)
+    elif problem in FEYNMAN_SI:
+        X, y, ops, x_units, y_unit = _feynman_si_problem(problem, rng)
     else:
         X, y, ops = _feynman_problem(problem, rng)
 
-    options = Options(
-        maxsize=30, populations=31, population_size=27,
-        ncycles_per_iteration=380, save_to_file=False, **ops,
-    )
-    ds = make_dataset(X, y)
+    if leg == "tpunative":
+        options = Options(
+            maxsize=30, populations=512, population_size=256,
+            tournament_selection_n=16, ncycles_per_iteration=100,
+            save_to_file=False, **ops,
+        )
+        chunks = [20] * 5
+    else:  # refproxy / tpu31: the reference's own configuration
+        options = Options(
+            maxsize=30, populations=31, population_size=27,
+            ncycles_per_iteration=380, save_to_file=False, **ops,
+        )
+        chunks = [95] * 4
+    ds = make_dataset(X, y, X_units=x_units, y_units=y_unit)
     ds.update_baseline_loss(options.elementwise_loss)
     engine = Engine(options, ds.nfeatures)
     state = engine.init_state(search_key(seed), ds.data, options.populations)
 
-    # warmup = compile at final shapes (excluded from the budget: both
-    # platforms pay XLA compile once per config, and the comparison is
-    # about search progress, not compile latency)
-    state = engine.run_iteration(state, ds.data, options.maxsize)
+    eval_budget = REF_RATE * budget_s if leg == "refproxy" else None
+
+    # warmup = compile at final shapes (excluded from the budget: every
+    # leg pays XLA compile once per config, and the comparison is about
+    # search progress, not compile latency). Uses the same chunked form
+    # as the measured loop so all chunk lengths compile here.
+    state = engine.run_iteration(state, ds.data, options.maxsize,
+                                 chunk_sizes=chunks)
     jax.block_until_ready(state.pops.cost)
+    evals0 = float(state.num_evals)
 
     curve = []
     t0 = time.perf_counter()
+
+    def elapsed():
+        return time.perf_counter() - t0
+
+    def budget_left():
+        if eval_budget is not None:
+            return (float(state.num_evals) - evals0) < eval_budget
+        return elapsed() < budget_s
+
     while True:
-        state = engine.run_iteration(state, ds.data, options.maxsize)
+        # Chunked execution with a budget check between chunks: a wall
+        # budget can stop mid-iteration (verdict weak #5 — iterations
+        # must not overrun the budget by multiples).
+        stop = (None if eval_budget is not None
+                else (lambda pending: elapsed() >= budget_s))
+        state = engine.run_iteration(state, ds.data, options.maxsize,
+                                     chunk_sizes=chunks, should_stop=stop)
         jax.block_until_ready(state.pops.cost)
-        el = time.perf_counter() - t0
+        evals = float(state.num_evals) - evals0
+        # x-axis: real seconds, except the proxy's virtual clock
+        xval = evals / REF_RATE if eval_budget is not None else elapsed()
         loss = np.asarray(state.pops.loss).ravel()
         cx = np.asarray(state.pops.complexity).ravel()
         finite = np.isfinite(loss)
         best = float(loss[finite].min()) if finite.any() else float("inf")
-        curve.append([round(el, 2), best])
-        if el >= budget_s:
+        curve.append([round(xval, 2), best])
+        if not budget_left():
             break
 
     # final pareto front: min loss per complexity, dominated points culled
@@ -147,33 +238,33 @@ def single_run(problem: str, platform: str, seed: int, budget_s: float):
             pareto.append([c, front[c]])
 
     print(json.dumps({
-        "problem": problem, "platform": platform, "seed": seed,
+        "problem": problem, "leg": leg, "seed": seed,
         "budget_s": budget_s, "iters": len(curve),
-        "num_evals": float(state.num_evals),
+        "num_evals": float(state.num_evals) - evals0,
+        "real_wall_s": round(elapsed(), 1),
         "best_loss": curve[-1][1] if curve else float("inf"),
         "curve": curve, "front": pareto,
     }))
 
 
-def _run_one(problem, plat, seed, budget):
-    """Launch one run subprocess and parse its JSON line (shared by
-    suite() and repair()); timeouts and parse failures come back as
-    error records instead of raising."""
+def _run_one(problem, leg, seed, budget):
+    """Launch one run subprocess and parse its JSON line; timeouts and
+    parse failures come back as error records instead of raising."""
     here = os.path.abspath(__file__)
-    cmd = [sys.executable, here, "--run", problem, plat, str(seed),
+    cmd = [sys.executable, here, "--run", problem, leg, str(seed),
            str(budget)]
     t0 = time.time()
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=budget * 6 + 600)
+                             timeout=budget * 10 + 900)
         line = (out.stdout.strip().splitlines()[-1]
                 if out.stdout.strip() else "")
         rec = json.loads(line)
     except subprocess.TimeoutExpired:
-        rec = {"problem": problem, "platform": plat, "seed": seed,
-               "error": f"timeout after {budget * 6 + 600:.0f}s"}
+        rec = {"problem": problem, "leg": leg, "seed": seed,
+               "error": f"timeout after {budget * 10 + 900:.0f}s"}
     except json.JSONDecodeError:
-        rec = {"problem": problem, "platform": plat, "seed": seed,
+        rec = {"problem": problem, "leg": leg, "seed": seed,
                "error": out.stderr[-500:]}
     rec["wall_s"] = round(time.time() - t0, 1)
     return rec
@@ -182,70 +273,114 @@ def _run_one(problem, plat, seed, budget):
 def suite(args):
     here = os.path.abspath(__file__)
     runs = []
-    for seed in range(args.seeds_bench):
-        for plat in ("tpu", "cpu"):
-            runs.append(("bench", plat, seed, args.budget_bench))
-    for name in FEYNMAN:
-        for seed in range(args.seeds_feynman):
-            for plat in ("tpu", "cpu"):
-                runs.append((name, plat, seed, args.budget_feynman))
+    if getattr(args, "suite_si", False):
+        # SI-united Feynman tier: dimensional analysis active end-to-end
+        for name in FEYNMAN_SI:
+            for seed in range(args.seeds_feynman):
+                for leg in ("refproxy", "tpunative"):
+                    runs.append((name, leg, seed, args.budget_feynman))
+    else:
+        for seed in range(args.seeds_bench):
+            for leg in LEGS:
+                runs.append(("bench", leg, seed, args.budget_bench))
+        for name in FEYNMAN:
+            for seed in range(args.seeds_feynman):
+                for leg in LEGS:
+                    runs.append((name, leg, seed, args.budget_feynman))
 
     results = []
-    for problem, plat, seed, budget in runs:
-        rec = _run_one(problem, plat, seed, budget)
+    for problem, leg, seed, budget in runs:
+        rec = _run_one(problem, leg, seed, budget)
         results.append(rec)
-        print(f"{problem:10s} {plat:4s} seed={seed}: "
-              f"best={rec.get('best_loss', 'ERR')}", flush=True)
-
-    out_path = os.path.join(os.path.dirname(here), "quality_results.json")
-    summary = summarize(results)
-    with open(out_path, "w") as f:
-        json.dump({"runs": results, "summary": summary,
-                   "config": vars(args)}, f, indent=1)
+        print(f"{problem:10s} {leg:9s} seed={seed}: "
+              f"best={rec.get('best_loss', 'ERR')} "
+              f"(real {rec.get('real_wall_s', '?')}s)", flush=True)
+        # incremental save so a crash keeps partial results
+        out_path = os.path.join(
+            os.path.dirname(here),
+            "quality_si_results.json" if getattr(args, "suite_si", False)
+            else "quality_results.json")
+        with open(out_path, "w") as f:
+            json.dump({"runs": results, "summary": summarize(results),
+                       "config": vars(args), "ref_rate": REF_RATE},
+                      f, indent=1)
     print("wrote", out_path)
-    _print_summary(summary)
+    _print_summary(summarize(results))
 
 
 SOLVED = 1e-8  # below this, a law is exactly recovered (f32 noise floor)
 
 
-def summarize(results):
-    """Per problem: median best loss per platform and a not-worse count.
+def _time_to(curve, target):
+    """First x with best <= max(target * 1.05, SOLVED); None if never."""
+    thr = max(target * 1.05, SOLVED)
+    for x, b in curve:
+        if b <= thr:
+            return x
+    return None
 
-    Losses below SOLVED are exact recoveries — when both platforms
-    solve a problem, residual epsilons (1e-13 vs 1e-16) are noise, not
-    a quality difference, and count as not-worse.
+
+def summarize(results):
+    """Per problem: median final loss per leg + wall-to-target ratios.
+
+    ``speedup_vs_ref``: per seed, proxy virtual budget / tpunative real
+    time-to-(proxy's final loss); >1 means the TPU-native config reaches
+    rate-matched-reference quality in less wall-clock.
     """
     summary = {}
-    for problem in ["bench"] + list(FEYNMAN):
+    problems = []
+    for r in results:
+        if r.get("problem") not in problems:
+            problems.append(r.get("problem"))
+    for problem in problems:
         rows = [r for r in results if r.get("problem") == problem
                 and "best_loss" in r]
         med = {}
-        for plat in ("tpu", "cpu"):
-            ls = sorted(r["best_loss"] for r in rows
-                        if r["platform"] == plat)
-            med[plat] = ls[len(ls) // 2] if ls else None
-        wins = 0
-        seeds = {r["seed"] for r in rows}
+        for leg in LEGS:
+            ls = sorted(r["best_loss"] for r in rows if r["leg"] == leg)
+            med[leg] = ls[len(ls) // 2] if ls else None
+        per_seed = []
+        not_worse = 0
+        seeds = sorted({r["seed"] for r in rows})
         for sd in seeds:
-            t = next((r["best_loss"] for r in rows
-                      if r["platform"] == "tpu" and r["seed"] == sd), None)
-            c = next((r["best_loss"] for r in rows
-                      if r["platform"] == "cpu" and r["seed"] == sd), None)
-            if t is None or c is None:
+            proxy = next((r for r in rows
+                          if r["leg"] == "refproxy" and r["seed"] == sd), None)
+            native = next((r for r in rows
+                           if r["leg"] == "tpunative" and r["seed"] == sd),
+                          None)
+            if proxy is None or native is None:
                 continue
-            if (t < SOLVED and c < SOLVED) or t <= c * 1.05:
-                wins += 1
-        summary[problem] = {"median_best": med,
-                            "tpu_not_worse": wins, "n_seeds": len(seeds)}
+            t_n = native["best_loss"]
+            t_p = proxy["best_loss"]
+            if (t_n < SOLVED and t_p < SOLVED) or t_n <= t_p * 1.05:
+                not_worse += 1
+            tt = _time_to(native["curve"], t_p)
+            # proxy "spent" its full virtual budget reaching t_p
+            proxy_time = proxy["curve"][-1][0] if proxy["curve"] else None
+            per_seed.append({
+                "seed": sd, "proxy_final": t_p, "native_final": t_n,
+                "native_time_to_proxy_final": tt,
+                "speedup_vs_ref": (round(proxy_time / tt, 2)
+                                   if (tt and proxy_time) else None),
+            })
+        sp = sorted(s["speedup_vs_ref"] for s in per_seed
+                    if s["speedup_vs_ref"] is not None)
+        summary[problem] = {
+            "median_best": med,
+            "native_not_worse_than_proxy": f"{not_worse}/{len(seeds)}",
+            "median_speedup_vs_ref": sp[len(sp) // 2] if sp else None,
+            "per_seed": per_seed,
+        }
     return summary
 
 
 def _print_summary(summary):
     for k, v in summary.items():
-        print(f"  {k:10s} median tpu={v['median_best']['tpu']} "
-              f"cpu={v['median_best']['cpu']} "
-              f"tpu_not_worse={v['tpu_not_worse']}/{v['n_seeds']}")
+        m = v["median_best"]
+        print(f"  {k:10s} proxy={m.get('refproxy')} "
+              f"tpu31={m.get('tpu31')} native={m.get('tpunative')} "
+              f"not_worse={v['native_not_worse_than_proxy']} "
+              f"speedup={v['median_speedup_vs_ref']}")
 
 
 def repair(args):
@@ -258,11 +393,11 @@ def repair(args):
     for i, r in enumerate(results):
         if "best_loss" in r:
             continue
-        problem, plat, seed = r["problem"], r["platform"], r["seed"]
+        problem, leg, seed = r["problem"], r["leg"], r["seed"]
         budget = (payload["config"]["budget_bench"] if problem == "bench"
                   else payload["config"]["budget_feynman"])
-        print(f"re-running {problem} {plat} seed={seed}", flush=True)
-        results[i] = _run_one(problem, plat, seed, budget)
+        print(f"re-running {problem} {leg} seed={seed}", flush=True)
+        results[i] = _run_one(problem, leg, seed, budget)
     payload["summary"] = summarize(results)
     with open(out_path, "w") as f:
         json.dump(payload, f, indent=1)
@@ -272,22 +407,24 @@ def repair(args):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--run", nargs=4, metavar=("PROBLEM", "PLplatform",
-                                               "SEED", "BUDGET"))
+    ap.add_argument("--run", nargs=4, metavar=("PROBLEM", "LEG", "SEED",
+                                               "BUDGET"))
     ap.add_argument("--suite", action="store_true")
+    ap.add_argument("--suite-si", action="store_true",
+                    help="SI-united Feynman tier (dimensional analysis on)")
     ap.add_argument("--repair", action="store_true",
                     help="re-run errored records in quality_results.json")
-    ap.add_argument("--budget-bench", type=float, default=60.0)
-    ap.add_argument("--budget-feynman", type=float, default=40.0)
-    ap.add_argument("--seeds-bench", type=int, default=4)
+    ap.add_argument("--budget-bench", type=float, default=75.0)
+    ap.add_argument("--budget-feynman", type=float, default=45.0)
+    ap.add_argument("--seeds-bench", type=int, default=3)
     ap.add_argument("--seeds-feynman", type=int, default=2)
     args = ap.parse_args()
     if args.run:
-        problem, plat, seed, budget = args.run
-        single_run(problem, plat, int(seed), float(budget))
+        problem, leg, seed, budget = args.run
+        single_run(problem, leg, int(seed), float(budget))
     elif args.repair:
         repair(args)
-    elif args.suite:
+    elif args.suite or args.suite_si:
         suite(args)
     else:
         print(__doc__)

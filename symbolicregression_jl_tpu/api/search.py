@@ -477,7 +477,7 @@ def equation_search(
         engine = Engine(options, ds.nfeatures, dtype=_np_dtype(options.eval_dtype),
                         n_params=n_params, n_classes=n_classes,
                         template=template, n_data_shards=ropt.n_data_shards,
-                        n_island_shards=n_island_shards)
+                        n_island_shards=n_island_shards, mesh=mesh)
         data = shard_device_data(ds.data, mesh)
         key, k_init = jax.random.split(key)
         if saved_state is not None and j < len(saved_state.device_states):
@@ -643,12 +643,17 @@ def equation_search(
         dev_t0 = time.time()
         monitor_host = dev_t0 - host_t0  # bookkeeping since last iteration
         chunk_sizes = _chunk_sizes()
+        iter_events = [None] * len(engines)
         for j, (engine, data) in enumerate(zip(engines, datas)):
-            states[j] = engine.run_iteration(
+            out = engine.run_iteration(
                 states[j], data, cur_maxsize,
                 chunk_sizes=chunk_sizes if len(chunk_sizes) > 1 else None,
                 should_stop=_budget_hit,
             )
+            if engine.cfg.record_events:
+                states[j], iter_events[j] = out
+            else:
+                states[j] = out
         jax.block_until_ready(states[-1].pops.cost)
         host_t0 = time.time()
         # Adapt chunk count toward the stop-latency target using this
@@ -711,6 +716,7 @@ def equation_search(
                 recorder.record_iteration(
                     it, j, states[j], hofs[j], float(states[j].num_evals),
                     variable_names=ds.variable_names,
+                    events=iter_events[j],
                 )
 
         if ropt.logger is not None and it % max(ropt.log_every_n, 1) == 0:

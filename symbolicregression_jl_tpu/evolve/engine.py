@@ -24,8 +24,19 @@ from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P_
 
 from ..core.dataset import DeviceData
+from ..parallel.mesh import ISLAND_AXIS
+
+try:  # jax >= 0.8: stable API (check_rep became check_vma)
+    from jax import shard_map as _jax_shard_map
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+        return _jax_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_rep)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
 from ..core.losses import loss_to_cost
 from ..core.options import Options
 from ..ops.complexity import ComplexityTables, build_complexity_tables, \
@@ -92,12 +103,13 @@ class Engine:
     def __init__(self, options: Options, nfeatures: int, dtype=jnp.float32,
                  window_size: int = 100_000, n_params: int = 0,
                  n_classes: int = 0, template=None, n_data_shards: int = 1,
-                 n_island_shards: int = 1):
+                 n_island_shards: int = 1, mesh=None):
         self.options = options
         self.nfeatures = nfeatures
         self.dtype = dtype
         self.template = template
         self.n_island_shards = n_island_shards
+        self.mesh = mesh
         if template is not None:
             # Template parameters ride the per-member parameter storage
             # as a flat [total_params, 1] bank.
@@ -105,7 +117,21 @@ class Engine:
             n_classes = 1 if n_params else 0
         self.cfg: EvolveConfig = evolve_config_from_options(
             options, nfeatures, n_params, n_classes, template=template,
-            n_data_shards=n_data_shards,
+            n_data_shards=n_data_shards, n_island_shards=n_island_shards,
+        )
+        # Pallas kernels have no GSPMD partitioning rule: when the island
+        # axis is sharded AND turbo is on, the island-local phases run
+        # under shard_map so each device drives its own kernel launches
+        # on local shards (SURVEY.md §2.4 TPU mapping; the jnp fallback
+        # partitions cleanly and needs none of this).
+        if self.cfg.turbo and n_island_shards > 1 and mesh is None:
+            # Without the mesh the island-local phases cannot be
+            # shard_map'ed and the Pallas kernels would hit GSPMD with
+            # no partitioning rule — fall back to the jnp interpreter,
+            # which partitions cleanly.
+            self.cfg = self.cfg._replace(turbo=False)
+        self._shard_islands = (
+            self.cfg.turbo and n_island_shards > 1 and mesh is not None
         )
         self.tables: ComplexityTables = build_complexity_tables(options, nfeatures)
         self.opt_cfg = OptimizerConfig(
@@ -256,13 +282,19 @@ class Engine:
         pops, birth, ref = state.pops, state.birth, state.ref
         carry = None
         c0 = 0
+        ev_chunks = []
         for i, nc in enumerate(chunk_sizes):
             fn = self._chunk_fn(nc, first=carry is None,
                                 batching=batch_idx is not None)
-            pops, best_seen, nev, birth, ref, marks = fn(
+            out = fn(
                 pops, birth, ref, state.stats.normalized_frequencies, data,
                 cur_maxsize, k_cycle, batch_idx, jnp.int32(c0), carry
             )
+            if cfg.record_events:
+                (pops, best_seen, nev, birth, ref, marks), ev = out[:6], out[6]
+                ev_chunks.append(ev)
+            else:
+                pops, best_seen, nev, birth, ref, marks = out
             carry = (best_seen, nev, marks)
             c0 += nc
             if should_stop is not None and i < len(chunk_sizes) - 1:
@@ -281,9 +313,14 @@ class Engine:
                 if should_stop(pending):
                     break
         evolved = (pops, best_seen, nev, birth, ref, marks)
-        return self._epilogue_fn(
+        new_state = self._epilogue_fn(
             state, data, cur_maxsize, evolved, key, k_opt, k_mig, batch_idx
         )
+        if cfg.record_events:
+            events = jax.tree.map(
+                lambda *xs: jnp.concatenate(xs, axis=1), *ev_chunks)
+            return new_state, events
+        return new_state
 
     def _chunk_fn(self, ncycles: int, first: bool, batching: bool):
         """Jitted evolve-chunk for a given (static) chunk length."""
@@ -313,23 +350,50 @@ class Engine:
                      k_cycle, batch_idx, c0, carry, cfg: EvolveConfig):
         """The evolve phase: cfg.ncycles bulk generation steps for all
         islands (one chunk). ``carry`` = (best_seen, nev, marks) from
-        prior chunks of the same iteration."""
+        prior chunks of the same iteration.
+
+        Under a sharded island axis with turbo, the per-island vmap runs
+        inside shard_map so each device dispatches the Pallas kernels on
+        its local islands (no cross-island ops exist in s_r_cycle).
+        Per-island RNG keys are computed globally first, so shard layout
+        never changes the streams."""
         I = birth.shape[0]
         cycle_keys = jax.random.split(k_cycle, I)
         total = self.cfg.ncycles  # the FULL iteration's cycle count
+        has_batch = batch_idx is not None
+        has_carry = carry is not None
 
-        def island_cycle(k, pop, b, r, ci):
-            return s_r_cycle(
-                k, pop, data, stats_nf, cur_maxsize, b, r, cfg,
-                self.options, self.tables, self.options.elementwise_loss,
-                batch_idx=batch_idx, c0=c0, total_cycles=total, carry_in=ci,
-            )
+        def run(ck, p, b, r, ci, snf, dat, cm, bi, c0_):
+            def island_cycle(k, pop, bb, rr, cin):
+                return s_r_cycle(
+                    k, pop, dat, snf, cm, bb, rr, cfg,
+                    self.options, self.tables,
+                    self.options.elementwise_loss,
+                    batch_idx=bi, c0=c0_, total_cycles=total, carry_in=cin,
+                )
 
-        if carry is None:
-            return jax.vmap(
-                lambda k, p, b, r: island_cycle(k, p, b, r, None)
-            )(cycle_keys, pops, birth, ref)
-        return jax.vmap(island_cycle)(cycle_keys, pops, birth, ref, carry)
+            if ci is None:
+                return jax.vmap(
+                    lambda k, pp, bb, rr: island_cycle(k, pp, bb, rr, None)
+                )(ck, p, b, r)
+            return jax.vmap(island_cycle)(ck, p, b, r, ci)
+
+        args = (cycle_keys, pops, birth, ref, carry, stats_nf, data,
+                cur_maxsize, batch_idx, c0)
+        if not self._shard_islands:
+            return run(*args)
+
+        isl = lambda tree: jax.tree.map(lambda _: P_(ISLAND_AXIS), tree)
+        rep = lambda tree: jax.tree.map(lambda _: P_(), tree)
+        in_specs = (
+            P_(ISLAND_AXIS), isl(pops), P_(ISLAND_AXIS), P_(ISLAND_AXIS),
+            isl(carry) if has_carry else None,
+            P_(), rep(data), P_(),
+            P_() if has_batch else None, P_(),
+        )
+        out_specs = isl(jax.eval_shape(run, *args))
+        return _shard_map(run, mesh=self.mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)(*args)
 
     def _iteration_impl(self, state: SearchDeviceState, data: DeviceData,
                         cur_maxsize, cfg: Optional[EvolveConfig] = None):
@@ -351,29 +415,37 @@ class Engine:
             state.stats.normalized_frequencies, data, cur_maxsize,
             k_cycle, batch_idx, jnp.int32(0), None, cfg,
         )
-        return self._epilogue_part(
+        events = None
+        if cfg.record_events:
+            events = evolved[6]
+            evolved = evolved[:6]
+        new_state = self._epilogue_part(
             state, data, cur_maxsize, evolved, key, k_opt, k_mig, batch_idx,
             cfg,
         )
+        if cfg.record_events:
+            return new_state, events
+        return new_state
 
-    def _epilogue_part(self, state: SearchDeviceState, data: DeviceData,
-                       cur_maxsize, evolved, key, k_opt, k_mig, batch_idx,
-                       cfg: EvolveConfig):
-        """Everything after the cycles: optimize & simplify, full-dataset
-        finalize, lineage rotation, HoF merge, migration, running stats
-        (runs exactly once per iteration, chunked or not)."""
+    def _island_epilogue(self, pops: PopulationState, ref, simp_mark,
+                         opt_mark, scores, gate, opt_key, data: DeviceData,
+                         cur_maxsize, batch_idx, cfg: EvolveConfig,
+                         k_sel: int, use_dedup: bool, sharded: bool):
+        """The island-LOCAL epilogue: fold/simplify, constant optimize,
+        full-dataset finalize, lineage ref rotation. No cross-island
+        communication — shard_map-able over the island axis (SURVEY.md
+        §2.4 TPU mapping). All random draws (``scores``, ``gate``,
+        ``opt_key``) are made by the caller so shard layouts cannot
+        change the streams; under shard_map the fused optimizer's key is
+        decorrelated per shard via axis_index.
+
+        Returns (pops, ref, f_calls[1]).
+        """
         options = self.options
         tables = self.tables
         el_loss = options.elementwise_loss
-        I = state.birth.shape[0]
+        I = pops.cost.shape[0]  # LOCAL island count under shard_map
         P = cfg.population_size
-        eval_fraction = (
-            cfg.batch_size / data.y.shape[0] if cfg.batching else 1.0
-        )
-
-        pops, best_seen, nev, birth, ref, marks = evolved
-        simp_mark, opt_mark = marks  # [I, P] bools
-        num_evals = state.num_evals + jnp.sum(nev) * eval_fraction
 
         # ---- optimize & simplify (src/SingleIteration.jl:68-96) ----
         # `simplify`-kind mutations are deferred to here (see
@@ -401,34 +473,9 @@ class Engine:
                 pops, trees=_select_tree(simp_mark, folded, pops.trees)
             )
 
-        # A fixed-size random subset per island keeps the grad-BFGS vmap's
-        # rematerialized buffers bounded instead of scaling with P. Each
-        # selected slot is gated by a bernoulli so the *expected* optimized
-        # count is exactly P * optimizer_probability, matching the
-        # reference's per-member coin flip (src/SingleIteration.jl:77-85)
-        # even when that product is < 0.5.
-        k_sel = max(1, round(P * options.optimizer_probability))
-        gate_p = min(P * options.optimizer_probability / k_sel, 1.0)
+        f_calls_total = jnp.zeros((1,), jnp.float32)
         opt_kind_on = float(options.mutation_weights.optimize) > 0
-        if opt_kind_on:
-            # Size the selection to cover the expected number of members
-            # marked by `optimize`-kind mutations this iteration (the
-            # reference runs its optimize branch unconditionally per
-            # draw, src/Mutate.jl:571-658) — marks beyond k_sel slots
-            # would otherwise be dropped.
-            wvec = options.mutation_weights.as_vector()
-            frac_opt = float(options.mutation_weights.optimize) / max(
-                float(wvec.sum()), 1e-12
-            )
-            import math
-
-            expected = cfg.n_slots * cfg.ncycles * frac_opt
-            k_sel = max(k_sel, min(P, math.ceil(expected)))
-        if options.should_optimize_constants and (
-            options.optimizer_probability > 0 or opt_kind_on
-        ):
-            ko1, ko2, ko3 = jax.random.split(k_opt, 3)
-            scores = jax.random.uniform(ko1, (I, P))
+        if scores is not None:
             if opt_kind_on:
                 # `optimize`-kind mutations (deferred from the cycle; see
                 # generation_step) claim selection slots first and bypass
@@ -436,16 +483,19 @@ class Engine:
                 # runs unconditionally on the member).
                 scores = scores + 10.0 * opt_mark.astype(scores.dtype)
             _, sel_idx = jax.lax.top_k(scores, k_sel)  # [I, k_sel]
-            gate = jax.random.bernoulli(ko3, gate_p, (I, k_sel))
             if opt_kind_on:
                 sel_marked = jnp.take_along_axis(opt_mark, sel_idx, axis=1)
                 gate = gate | sel_marked
 
+            if sharded:
+                # decorrelate the shards' optimizer restart draws
+                opt_key = jax.random.fold_in(
+                    opt_key, jax.lax.axis_index(ISLAND_AXIS))
             if cfg.turbo and cfg.template is None and cfg.n_params == 0:
-                # One flattened launch across all islands: the fused BFGS
-                # batches its line search through the Pallas kernel.
-                # (Templates and parametric members always take the jnp
-                # branch below — their joint constant+parameter
+                # One flattened launch across the local islands: the
+                # fused BFGS batches its line search through the Pallas
+                # kernel. (Templates and parametric members always take
+                # the jnp branch below — their joint constant+parameter
                 # optimization differentiates through the combiner /
                 # parameter gathers.)
                 sub = jax.vmap(
@@ -457,13 +507,13 @@ class Engine:
                     lambda x: x.reshape((I * k_sel,) + x.shape[2:]), sub
                 )
                 new_const_flat, improved, _, f_calls = optimize_constants_fused(
-                    ko2, flat_sub, gate.reshape(I * k_sel), data,
+                    opt_key, flat_sub, gate.reshape(I * k_sel), data,
                     el_loss, cfg.operators, self.opt_cfg,
                     batch_idx=batch_idx, interpret=cfg.interpret,
                 )
                 new_const_sub = new_const_flat.reshape(I, k_sel, -1)
             else:
-                opt_keys = jax.random.split(ko2, I)
+                opt_keys = jax.random.split(opt_key, I)
 
                 if cfg.template is not None:
                     from .constant_opt import optimize_constants_template
@@ -510,19 +560,33 @@ class Engine:
             pops = dataclasses.replace(
                 pops, trees=dataclasses.replace(pops.trees, const=new_const)
             )
-            num_evals = num_evals + jnp.sum(f_calls) * eval_fraction
+            f_calls_total = jnp.sum(f_calls).reshape(1)
 
-        # ---- finalize costs on the full dataset (finalize_costs,
-        # src/Population.jl:182-196; always re-eval after simplify/opt) ----
-        # Flattening the island axis (instead of vmapping) lets the
-        # fused path dedup the ~40-55% of members that are identical
-        # copies across the converged populations (migration/tournament
-        # clones — measured in profiling/dup_rate.py). Single-shard
-        # island layouts only: under a sharded island axis the dedup's
-        # global sorts would lower to cross-device collectives every
-        # iteration for a ~1.03-1.15x local win.
-        use_dedup = (cfg.turbo and cfg.template is None
-                     and cfg.n_params == 0 and self.n_island_shards == 1)
+        pops = self._finalize_costs(pops, data, cfg, use_dedup)
+
+        # Lineage rotation (src/SingleIteration.jl:99-104).
+        new_refs = ref[:, None] + jnp.arange(P, dtype=jnp.int32)[None, :]
+        pops = dataclasses.replace(pops, parent=pops.ref, ref=new_refs)
+        ref = ref + P
+        return pops, ref, f_calls_total
+
+    def _finalize_costs(self, pops: PopulationState, data: DeviceData,
+                        cfg: EvolveConfig, use_dedup: bool
+                        ) -> PopulationState:
+        """Finalize costs on the full dataset (finalize_costs,
+        src/Population.jl:182-196; always re-eval after simplify/opt).
+
+        With ``use_dedup`` the island axis flattens (instead of vmapping)
+        so the fused path dedups the ~40-55% of members that are
+        identical copies across the converged populations
+        (migration/tournament clones — measured in profiling/dup_rate.py).
+        Single-shard island layouts only: under a sharded island axis
+        the dedup's global sorts would need cross-device collectives
+        every iteration for a ~1.03-1.15x local win."""
+        options = self.options
+        tables = self.tables
+        el_loss = options.elementwise_loss
+        I, P = pops.cost.shape
         if use_dedup:
             flat_trees = jax.tree.map(
                 lambda x: x.reshape((I * P,) + x.shape[2:]), pops.trees)
@@ -551,13 +615,97 @@ class Engine:
                     template=cfg.template,
                 )
             )(pops.trees, pops.params)
-        pops = dataclasses.replace(pops, cost=cost, loss=loss, complexity=cx)
-        num_evals = num_evals + I * P
+        return dataclasses.replace(pops, cost=cost, loss=loss,
+                                   complexity=cx)
 
-        # Lineage rotation (src/SingleIteration.jl:99-104).
-        new_refs = ref[:, None] + jnp.arange(P, dtype=jnp.int32)[None, :]
-        pops = dataclasses.replace(pops, parent=pops.ref, ref=new_refs)
-        ref = ref + P
+    def _epilogue_part(self, state: SearchDeviceState, data: DeviceData,
+                       cur_maxsize, evolved, key, k_opt, k_mig, batch_idx,
+                       cfg: EvolveConfig):
+        """Everything after the cycles: optimize & simplify, full-dataset
+        finalize, lineage rotation, HoF merge, migration, running stats
+        (runs exactly once per iteration, chunked or not).
+
+        The island-local parts run through ``_island_epilogue`` — under
+        ``shard_map`` when the island axis is sharded and turbo is on
+        (Pallas kernels have no GSPMD partitioning rule; shard_map runs
+        them per-device on local shards). Cross-island parts (hall-of-
+        fame merge, migration, running stats) stay in GSPMD-land where
+        XLA inserts the collectives.
+        """
+        options = self.options
+        tables = self.tables
+        el_loss = options.elementwise_loss
+        I = state.birth.shape[0]
+        P = cfg.population_size
+        eval_fraction = (
+            cfg.batch_size / data.y.shape[0] if cfg.batching else 1.0
+        )
+
+        pops, best_seen, nev, birth, ref, marks = evolved
+        simp_mark, opt_mark = marks  # [I, P] bools
+        num_evals = state.num_evals + jnp.sum(nev) * eval_fraction
+
+        # All epilogue randomness is drawn here, island-major, so the
+        # shard layout cannot change the streams (src/SingleIteration.jl
+        # :77-85 per-member coin flips).
+        k_sel = max(1, round(P * options.optimizer_probability))
+        gate_p = min(P * options.optimizer_probability / k_sel, 1.0)
+        opt_kind_on = float(options.mutation_weights.optimize) > 0
+        if opt_kind_on:
+            # Size the selection to cover the expected number of members
+            # marked by `optimize`-kind mutations this iteration (the
+            # reference runs its optimize branch unconditionally per
+            # draw, src/Mutate.jl:571-658) — marks beyond k_sel slots
+            # would otherwise be dropped.
+            wvec = options.mutation_weights.as_vector()
+            frac_opt = float(options.mutation_weights.optimize) / max(
+                float(wvec.sum()), 1e-12
+            )
+            import math
+
+            expected = cfg.n_slots * cfg.ncycles * frac_opt
+            k_sel = max(k_sel, min(P, math.ceil(expected)))
+        do_optimize = options.should_optimize_constants and (
+            options.optimizer_probability > 0 or opt_kind_on
+        )
+        scores = gate = None
+        ko2 = k_opt
+        if do_optimize:
+            ko1, ko2, ko3 = jax.random.split(k_opt, 3)
+            scores = jax.random.uniform(ko1, (I, P))
+            gate = jax.random.bernoulli(ko3, gate_p, (I, k_sel))
+
+        use_dedup = (cfg.turbo and cfg.template is None
+                     and cfg.n_params == 0 and self.n_island_shards == 1)
+
+        if self._shard_islands:
+            isl = lambda tree: jax.tree.map(lambda _: P_(ISLAND_AXIS), tree)
+            rep = lambda tree: jax.tree.map(lambda _: P_(), tree)
+            args = (pops, ref, simp_mark, opt_mark, scores, gate, ko2,
+                    data, cur_maxsize, batch_idx)
+            specs = (isl(pops), P_(ISLAND_AXIS), P_(ISLAND_AXIS),
+                     P_(ISLAND_AXIS),
+                     None if scores is None else P_(ISLAND_AXIS),
+                     None if gate is None else P_(ISLAND_AXIS),
+                     rep(ko2), rep(data), P_(),
+                     None if batch_idx is None else P_())
+            fn = _shard_map(
+                lambda *a: self._island_epilogue(
+                    *a, cfg=cfg, k_sel=k_sel, use_dedup=False,
+                    sharded=True),
+                mesh=self.mesh,
+                in_specs=specs,
+                out_specs=(isl(pops), P_(ISLAND_AXIS), P_(ISLAND_AXIS)),
+                check_rep=False,
+            )
+            pops, ref, f_calls = fn(*args)
+        else:
+            pops, ref, f_calls = self._island_epilogue(
+                pops, ref, simp_mark, opt_mark, scores, gate, ko2, data,
+                cur_maxsize, batch_idx, cfg, k_sel, use_dedup,
+                sharded=False)
+        num_evals = num_evals + jnp.sum(f_calls) * eval_fraction
+        num_evals = num_evals + I * P  # the finalize re-eval
 
         # ---- merge best_seen + final pops into the global HoF ----
         hof = state.hof

@@ -40,8 +40,30 @@ from .population import PopulationState
 from .rng import categorical_from_weights
 from .tournament import tournament_select
 
-__all__ = ["EvolveConfig", "HofState", "generation_step", "s_r_cycle", "empty_hof",
-           "update_hof", "eval_cost_batch"]
+__all__ = ["CycleEvents", "EvolveConfig", "HofState", "generation_step",
+           "s_r_cycle", "empty_hof", "update_hof", "eval_cost_batch"]
+
+
+class CycleEvents(NamedTuple):
+    """Per-cycle genealogy events, one entry per candidate baby [2B]
+    (the reference's per-mutation @recorder stream,
+    /root/reference/src/RegularizedEvolution.jl:47-75,105-149, emitted
+    as int32/f32 side outputs of the already-computed generation step).
+
+    ``kind`` is the sampled mutation-kind index; ``len(MUTATION_KINDS)``
+    denotes crossover. Crossover rows carry both parents; ``died_ref``
+    is the ref of the (oldest) member the baby replaced. Rows with
+    ``accepted == False`` were candidate babies that failed constraints
+    / rejection sampling — the reference logs those with their reject
+    reason too."""
+
+    kind: jax.Array         # int32 [2B]
+    parent_ref: jax.Array   # int32 [2B]
+    parent2_ref: jax.Array  # int32 [2B]  (-1 unless crossover)
+    child_ref: jax.Array    # int32 [2B]
+    died_ref: jax.Array     # int32 [2B]  (-1 when not accepted)
+    accepted: jax.Array     # bool  [2B]
+    cost_delta: jax.Array   # f32   [2B]  child cost - parent cost
 
 _KIND = {name: i for i, name in enumerate(MUTATION_KINDS)}
 _IMMEDIATE_KINDS = (_KIND["simplify"], _KIND["do_nothing"], _KIND["optimize"],
@@ -84,6 +106,8 @@ class EvolveConfig(NamedTuple):
     # parameter banks [n_params, n_classes]; 0 = plain expressions.
     n_params: int = 0
     n_classes: int = 0
+    # Emit CycleEvents from every generation step (options.use_recorder).
+    record_events: bool = False
     # Template expressions (TemplateExpressionSpec): the static structure
     # (combiner + per-key arities); trees gain a leading key axis [K, L]
     # and params hold the flat template parameter bank [total, 1].
@@ -111,7 +135,8 @@ class EvolveConfig(NamedTuple):
 def evolve_config_from_options(options: Options, nfeatures: int,
                                n_params: int = 0, n_classes: int = 0,
                                template=None,
-                               n_data_shards: int = 1) -> EvolveConfig:
+                               n_data_shards: int = 1,
+                               n_island_shards: int = 1) -> EvolveConfig:
     on_tpu = jax.default_backend() == "tpu"
     turbo = options.turbo if options.turbo is not None else on_tpu
     if turbo and not supports_fused_eval(options.operators):
@@ -129,6 +154,11 @@ def evolve_config_from_options(options: Options, nfeatures: int,
         # with per-shard loss partials); the jnp interpreter partitions
         # cleanly over the data axis, with the final loss reduction
         # lowering to a psum over ICI.
+        turbo = False
+    if n_island_shards > 1 and (template is not None or n_params > 0):
+        # The shard_map turbo path (engine._shard_islands) covers plain
+        # expressions; template/parametric searches under island sharding
+        # take the jnp interpreter, which GSPMD partitions cleanly.
         turbo = False
     return EvolveConfig(
         operators=options.operators,
@@ -165,6 +195,7 @@ def evolve_config_from_options(options: Options, nfeatures: int,
         n_params=n_params,
         n_classes=n_classes,
         template=template,
+        record_events=bool(getattr(options, "use_recorder", False)),
     )
 
 
@@ -927,6 +958,29 @@ def generation_step(
     )
     new_birth = birth0 + jnp.arange(nb, dtype=jnp.int32)
     new_ref = ref0 + jnp.arange(nb, dtype=jnp.int32)
+
+    events = None
+    if cfg.record_events:
+        XO = jnp.int32(len(MUTATION_KINDS))  # crossover pseudo-kind
+        k1 = jnp.where(is_xover, XO, kind)
+        # child-2 rows exist only for crossover slots; -1 marks the
+        # phantom rows so they never count as rejected crossovers
+        k2_kind = jnp.where(is_xover, XO, -1)
+        parent2_1 = jnp.where(is_xover, pop.ref[i2], -1)
+        parent_cost2 = jnp.stack([m1_cost, pop.cost[i2]], axis=1)
+        events = CycleEvents(
+            kind=jnp.stack([k1, k2_kind], axis=1).reshape(-1),
+            parent_ref=baby_parent.reshape(-1),
+            parent2_ref=jnp.stack([parent2_1, pop.ref[i1]],
+                                  axis=1).reshape(-1),
+            child_ref=new_ref,
+            died_ref=jnp.where(
+                flat_replace,
+                jnp.take(pop.ref, order[jnp.clip(rank, 0, P - 1)]), -1),
+            accepted=flat_replace,
+            cost_delta=(baby_cost.reshape(-1)
+                        - parent_cost2.reshape(-1)),
+        )
     new_pop = PopulationState(
         trees=new_trees,
         cost=scatter(pop.cost, baby_cost.reshape(-1)),
@@ -940,9 +994,12 @@ def generation_step(
         ),
     )
     if marks is None:
+        out = (new_pop, num_evals, birth0 + nb, ref0 + nb)
+        if cfg.record_events:
+            out = out + (events,)
         if return_candidates:
-            return new_pop, num_evals, birth0 + nb, ref0 + nb, eval_batch
-        return new_pop, num_evals, birth0 + nb, ref0 + nb
+            out = out + (eval_batch,)
+        return out
     # Deferred simplify/optimize marks ride the replacement scatter: the
     # surviving copy of the member carries the flag; replaced slots that
     # got ordinary babies are cleared.
@@ -957,9 +1014,12 @@ def generation_step(
         scatter(simp_mark, simp_flags),
         scatter(opt_mark, opt_flags),
     )
+    out = (new_pop, num_evals, birth0 + nb, ref0 + nb, new_marks)
+    if cfg.record_events:
+        out = out + (events,)
     if return_candidates:
-        return new_pop, num_evals, birth0 + nb, ref0 + nb, new_marks, eval_batch
-    return new_pop, num_evals, birth0 + nb, ref0 + nb, new_marks
+        out = out + (eval_batch,)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1077,16 +1137,24 @@ def s_r_cycle(
         else:
             temperature = jnp.asarray(1.0, pop.cost.dtype)
         k = jax.random.fold_in(key, gc)
-        pop, nev_c, birth, ref, marks = generation_step(
+        out = generation_step(
             k, pop, data, stats_nf, temperature, cur_maxsize, birth, ref,
             cfg, options, tables, elementwise_loss, batch_idx=batch_idx,
             marks=marks,
         )
+        if cfg.record_events:
+            pop, nev_c, birth, ref, marks, events = out
+        else:
+            pop, nev_c, birth, ref, marks = out
+            events = None
         hof = update_hof(hof, pop, cfg.maxsize)
-        return (pop, hof, birth, ref, nev + nev_c, marks), None
+        return (pop, hof, birth, ref, nev + nev_c, marks), events
 
-    (pop, hof, birth0, ref0, num_evals, marks), _ = jax.lax.scan(
+    (pop, hof, birth0, ref0, num_evals, marks), events = jax.lax.scan(
         cycle, (pop, hof0, birth0, ref0, nev0, marks0),
         jnp.arange(ncycles, dtype=jnp.int32),
     )
+    if cfg.record_events:
+        # events: CycleEvents of [ncycles, 2B] arrays
+        return pop, hof, num_evals, birth0, ref0, marks, events
     return pop, hof, num_evals, birth0, ref0, marks

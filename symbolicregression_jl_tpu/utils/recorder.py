@@ -6,13 +6,16 @@ the search accumulates a JSON-serializable record of the run and writes it
 to ``options.recorder_file`` at teardown
 (src/SymbolicRegression.jl:1231).
 
-Granularity note: the reference logs every mutation/death event from its
+Granularity: the reference logs every mutation/death event from its
 sequential per-member loop (src/RegularizedEvolution.jl:47-149). Here the
-whole generation runs inside one XLA program, so per-event host logging
-would serialize the device; instead the recorder snapshots the lineage
-state (ref/parent ids, costs, losses, complexities) of every island member
-once per iteration — the ref/parent chains reconstruct the same genealogy
-DAG — plus the full hall of fame with equation strings.
+whole generation runs inside one XLA program; per-event host callbacks
+would serialize the device, so the generation step instead emits
+`CycleEvents` — int32/f32 side arrays (kind, parent/child/died refs,
+accept flag, cost delta) per candidate baby per cycle — and the host
+recorder assembles them into the reference-style event stream
+("mutation"/"crossover" with parents, child, the member that died, and
+the accept decision), alongside the per-iteration lineage snapshots and
+the hall of fame with equation strings.
 """
 
 from __future__ import annotations
@@ -53,6 +56,7 @@ class Recorder:
         hof,
         num_evals: float,
         variable_names: Optional[Sequence[str]] = None,
+        events=None,
     ) -> None:
         pops = state.pops
         ref = np.asarray(pops.ref)
@@ -73,11 +77,15 @@ class Recorder:
                     "birth": birth[i].tolist(),
                 }
             )
+        event_log = None
+        if events is not None:
+            event_log = self._assemble_events(events)
         self.record["iterations"].append(
             {
                 "iteration": iteration,
                 "out": out_idx + 1,
                 "num_evals": float(num_evals),
+                "events": event_log,
                 "islands": islands,
                 "hall_of_fame": [
                     {
@@ -91,6 +99,46 @@ class Recorder:
                 ],
             }
         )
+
+    @staticmethod
+    def _assemble_events(events) -> List[Dict[str, Any]]:
+        """CycleEvents [I, ncycles, 2B] device arrays -> the
+        reference-style per-mutation log (accepted events expanded with
+        kind names; rejections kept as per-kind aggregate counts —
+        src/RegularizedEvolution.jl:47-75 records both)."""
+        from ..core.options import MUTATION_KINDS
+
+        kind = np.asarray(events.kind)
+        parent = np.asarray(events.parent_ref)
+        parent2 = np.asarray(events.parent2_ref)
+        child = np.asarray(events.child_ref)
+        died = np.asarray(events.died_ref)
+        accepted = np.asarray(events.accepted)
+        delta = np.asarray(events.cost_delta, np.float64)
+        names = list(MUTATION_KINDS) + ["crossover"]
+        I, C, NB = kind.shape
+        out: List[Dict[str, Any]] = []
+        rejects: Dict[str, int] = {}
+        for isl, cyc, b in zip(*np.nonzero(accepted)):
+            k = names[int(kind[isl, cyc, b])]
+            ev = {
+                "island": int(isl),
+                "cycle": int(cyc),
+                "type": k,
+                "parent": int(parent[isl, cyc, b]),
+                "child": int(child[isl, cyc, b]),
+                "died": int(died[isl, cyc, b]),
+                "cost_delta": _sanitize(float(delta[isl, cyc, b])),
+            }
+            p2 = int(parent2[isl, cyc, b])
+            if k == "crossover" and p2 >= 0:
+                ev["parent2"] = p2
+            out.append(ev)
+        rej_kinds, rej_counts = np.unique(
+            kind[~accepted & (kind >= 0)], return_counts=True)
+        rejects = {names[int(k)]: int(c)
+                   for k, c in zip(rej_kinds, rej_counts)}
+        return [{"accepted": out, "rejected_counts": rejects}]
 
     def record_final(self, key: str, value: Any) -> None:
         self.record["final_state"][key] = value

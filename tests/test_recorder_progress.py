@@ -54,6 +54,67 @@ def test_recorder_writes_genealogy(tmp_path):
     assert all(isinstance(e["equation"], str) for e in first["hall_of_fame"])
 
 
+def test_recorder_event_stream_reconstructs_lineage(tmp_path):
+    """Per-mutation events (src/RegularizedEvolution.jl:47-149 analogue):
+    every accepted event names a parent/child/died ref, kinds resolve to
+    real names, and parent refs chain onto earlier children within the
+    iteration (the genealogy DAG is reconstructible from events alone)."""
+    X, y = _problem()
+    options = Options(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=16,
+        ncycles_per_iteration=30,
+        tournament_selection_n=4,
+        optimizer_probability=0.0,
+        output_directory=str(tmp_path),
+        use_recorder=True,
+        recorder_file="rec.json",
+    )
+    equation_search(
+        X, y, options=options, niterations=1, verbosity=0, run_id="evrun",
+        seed=3,
+    )
+    with open(os.path.join(str(tmp_path), "evrun", "rec.json")) as f:
+        rec = json.load(f)
+    ev_block = rec["iterations"][0]["events"][0]
+    acc = ev_block["accepted"]
+    assert len(acc) > 10
+    from symbolicregression_jl_tpu.core.options import MUTATION_KINDS
+
+    names = set(MUTATION_KINDS) | {"crossover"}
+    per_island_children = {}
+    for e in acc:
+        assert e["type"] in names
+        assert e["child"] >= 0 and e["died"] >= 0
+        per_island_children.setdefault(e["island"], set())
+        if e["type"] == "crossover":
+            assert "parent2" in e
+    # Chain: some later event's parent is an earlier event's child of the
+    # same island (cycle order is recorded, so "earlier" is checkable).
+    chained = 0
+    for isl in per_island_children:
+        evs = sorted((e for e in acc if e["island"] == isl),
+                     key=lambda e: e["cycle"])
+        seen = set()
+        for e in evs:
+            if e["parent"] in seen:
+                chained += 1
+            seen.add(e["child"])
+    assert chained > 0, "no parent->child chains found across cycles"
+    # Death bookkeeping: a replaced (died) member is either one of the
+    # initial population (refs carry the island*1e6 tagging scheme from
+    # Engine.init_state) or an earlier counter-minted child, whose refs
+    # grow monotonically — so non-initial died refs strictly precede
+    # their replacement's ref.
+    for e in acc:
+        is_initial = e["died"] >= 1_000_000 or e["died"] < 16  # P=16
+        assert is_initial or e["died"] < e["child"], e
+    assert isinstance(ev_block["rejected_counts"], dict)
+
+
 def test_progress_bar_smoke(tmp_path, capsys):
     X, y = _problem()
     options = _options(tmp_path, save_to_file=False)

@@ -38,6 +38,41 @@ def problem():
     return X, y
 
 
+def test_device_scale_auto(monkeypatch):
+    """device_scale='auto': TPU backends get the config-sweep optimum
+    unless the user pins a scale knob; CPU and device_scale=False keep
+    the reference defaults (round-4 verdict item 4)."""
+    import jax
+
+    from symbolicregression_jl_tpu.api.regressor import SRRegressor
+
+    r = SRRegressor()
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    opts = r._make_options()
+    assert r.device_scaled_
+    assert opts.populations == 512 and opts.population_size == 256
+    assert opts.tournament_selection_n == 16
+    assert opts.ncycles_per_iteration == 100
+
+    # user pins any scale knob -> no auto-scaling at all
+    r2 = SRRegressor(populations=10)
+    opts2 = r2._make_options()
+    assert not r2.device_scaled_
+    assert opts2.populations == 10
+    assert opts2.population_size != 256  # reference default preserved
+
+    # explicit off
+    r3 = SRRegressor(device_scale=False)
+    opts3 = r3._make_options()
+    assert not r3.device_scaled_ and opts3.populations != 512
+
+    # CPU backend -> reference defaults
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    r4 = SRRegressor()
+    opts4 = r4._make_options()
+    assert not r4.device_scaled_ and opts4.populations != 512
+
+
 @pytest.mark.slow
 def test_fit_predict_score(problem):
     X, y = problem

