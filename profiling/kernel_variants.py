@@ -69,12 +69,24 @@ def _make_kernel(operators, loss_fn, tree_block, nfeat, cmax, variant):
         for t in range(tree_block):
             bdt = buf_ref.dtype
 
-            def cbody(c, _):
-                buf_ref[nfeat + c, :] = jnp.full(
-                    (tile,), cvals_ref[t, c], dtype=bdt)
-                return 0
+            if variant == "cvec":
+                # const preload as ONE vectorized broadcast store from a
+                # VMEM cvals block (vs the dynamic scalar fori_loop)
+                buf_ref[nfeat:nfeat + cmax, :] = jnp.broadcast_to(
+                    cvals_ref[t, :][:, None], (cmax, tile)).astype(bdt)
+            elif variant == "custatic":
+                # static unrolled preload: no scalar-loop bookkeeping,
+                # CMAX unconditional stores
+                for c in range(cmax):
+                    buf_ref[nfeat + c, :] = jnp.full(
+                        (tile,), cvals_ref[t, c], dtype=bdt)
+            else:
+                def cbody(c, _):
+                    buf_ref[nfeat + c, :] = jnp.full(
+                        (tile,), cvals_ref[t, c], dtype=bdt)
+                    return 0
 
-            jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
+                jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
 
             def step(k, vmask):
                 w_ = instr_ref[t, k]
@@ -214,8 +226,10 @@ def loss_variant(prog, X, y, nfeatures, operators, loss_fn,
         in_specs=[
             smem_i32((TB, instr.shape[-1])), smem_i32((TB, 1)),
             smem_i32((TB, 1)),
-            pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),
+            (pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0))
+             if variant == "cvec" else
+             pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0),
+                          memory_space=pltpu.SMEM)),
             smem_i32((TB, 1)),
             pl.BlockSpec((F, TILE), lambda i, j: (0, j)),
             row_spec, row_spec, row_spec,
