@@ -333,7 +333,8 @@ def main():
         jax.block_until_ready(loss)
         dt = (time.perf_counter() - t0) / N
         ok = ""
-        if vv in ("base", "cond2", "signmerge", "nounroll") or v.startswith("tb"):
+        if vv in ("base", "cond2", "signmerge", "nounroll", "cvec",
+                  "custatic") or v.startswith("tb"):
             if base_loss is None and v == "base":
                 base_loss = np.asarray(loss)
             elif base_loss is not None:

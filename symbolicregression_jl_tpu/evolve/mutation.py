@@ -125,9 +125,26 @@ def _structure(tree: TreeBatch, structure=None):
     return _tree_structure_single(tree.arity, tree.length)
 
 
+def _lane_get(x, k):
+    """``x[k]`` for a [L] array and dynamic scalar ``k`` via lane_take's
+    one-hot contraction. XLA lowers scalar dynamic-index gathers on TPU
+    to serialized kCustom fusions — at the bench config the mutation
+    kernels' scalar reads cost ~14 ms/cycle before this change
+    (profiling/trace_machinery.py). Out-of-range ``k`` yields 0; every
+    such read here is either index-valid by construction or fully
+    masked downstream (same discard the clamped gather produced)."""
+    return lane_take(x, jnp.asarray(k, jnp.int32).reshape(1))[0]
+
+
+def _row_get(mat, k):
+    """``mat[k, :]`` for an [L, A] array and dynamic scalar ``k`` — [A]."""
+    return lane_take(mat.T, jnp.asarray(k, jnp.int32).reshape(1))[..., 0]
+
+
 def _span(size, k):
     """(start, length) of the subtree rooted at slot k."""
-    return k - size[k] + 1, size[k]
+    sz = _lane_get(size, k)
+    return k - sz + 1, sz
 
 
 # ---------------------------------------------------------------------------
@@ -185,7 +202,7 @@ def mutate_operator(u, tree: TreeBatch, ctx: MutationContext):
     idx, has_any = u_masked_choice(s.take(ctx.max_nodes), mask)
     u_ops = s.take(len(ctx.nops))
     _assert_consumed(s, u, "mutate_operator")
-    a = tree.arity[idx]
+    a = _lane_get(tree.arity, idx)
     new_op = jnp.int32(0)
     for d, n in enumerate(ctx.nops, start=1):
         new_op = jnp.where(a == d, u_randint(u_ops[d - 1], max(n, 1)), new_op)
@@ -204,7 +221,7 @@ def mutate_feature(u, tree: TreeBatch, ctx: MutationContext):
     nf = jnp.asarray(ctx.nfeatures, jnp.int32)
     # uniform among features != current (src/MutationFunctions.jl:181)
     delta = u_randint(u_delta, jnp.maximum(nf - 1, 1)) + 1
-    new_feat = (tree.feat[idx] + delta) % jnp.maximum(nf, 1)
+    new_feat = (_lane_get(tree.feat, idx) + delta) % jnp.maximum(nf, 1)
     changed = has_any & (nf > 1)
     feat = jnp.where(changed, tree.feat.at[idx].set(new_feat), tree.feat)
     return TreeBatch(tree.arity, tree.op, feat, tree.const, tree.length), jnp.bool_(True)
@@ -221,8 +238,9 @@ def swap_operands(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     child, size, _ = _structure(tree, structure)
     mask = _slot_mask(tree) & (tree.arity == 2)
     k_node, has_any = u_masked_choice(u, mask)
-    c1 = child[k_node, 0]
-    c2 = child[k_node, 1]
+    crow = _row_get(child, k_node)
+    c1 = crow[0]
+    c2 = crow[1]
     s1, l1 = _span(size, c1)
     s2, l2 = _span(size, c2)
     sources = (tree.arity, tree.op, tree.feat, tree.const)
@@ -239,9 +257,10 @@ def delete_node(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     child, size, _ = _structure(tree, structure)
     mask = _slot_mask(tree) & (tree.arity > 0)
     k_node, has_any = u_masked_choice(s.take(L), mask)
-    carry_i = u_randint(s.take1(), jnp.maximum(tree.arity[k_node], 1))
+    carry_i = u_randint(s.take1(), jnp.maximum(_lane_get(tree.arity, k_node), 1))
     _assert_consumed(s, u, "delete_node")
-    carry = child[k_node, jnp.clip(carry_i, 0, MAX_ARITY - 1)]
+    carry = _lane_get(_row_get(child, k_node),
+                      jnp.clip(carry_i, 0, MAX_ARITY - 1))
     node_start, node_len = _span(size, k_node)
     carry_start, carry_len = _span(size, carry)
     sources = (tree.arity, tree.op, tree.feat, tree.const)
@@ -434,29 +453,37 @@ def rotate_tree(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     s = USlice(u)
     child, size, _ = _structure(tree, structure)
     slot_ok = _slot_mask(tree)
-    child_arity = tree.arity[jnp.clip(child, 0, L - 1)]  # [L, A]
+    # lane_take, not a [L, A] gather: the serialized kCustom lowering of
+    # this single line cost ~5 ms/cycle at the bench config.
+    child_arity = lane_take(tree.arity, jnp.clip(child, 0, L - 1))  # [L, A]
     has_op_child = jnp.any(
         (child_arity > 0) & (jnp.arange(MAX_ARITY) < tree.arity[:, None]), axis=1
     )
     root_mask = slot_ok & (tree.arity > 0) & has_op_child
     r, has_root = u_masked_choice(s.take(L), root_mask)
 
-    pivot_mask = (jnp.arange(MAX_ARITY) < tree.arity[r]) & (child_arity[r] > 0)
+    arity_r = _lane_get(tree.arity, r)
+    pivot_mask = ((jnp.arange(MAX_ARITY) < arity_r)
+                  & (_row_get(child_arity, r) > 0))
     pi, _ = u_masked_choice(s.take(MAX_ARITY), pivot_mask)
-    p = child[r, pi]
-    gi = u_randint(s.take1(), jnp.maximum(tree.arity[p], 1))
+    row_r = _row_get(child, r)
+    p = _lane_get(row_r, pi)
+    arity_p = _lane_get(tree.arity, p)
+    gi = u_randint(s.take1(), jnp.maximum(arity_p, 1))
     _assert_consumed(s, u, "rotate_tree")
-    g = child[p, jnp.clip(gi, 0, MAX_ARITY - 1)]
+    row_p = _row_get(child, p)
+    g = _lane_get(row_p, jnp.clip(gi, 0, MAX_ARITY - 1))
 
     def span_of(x):
-        return x - size[x] + 1, size[x]
+        sz = _lane_get(size, x)
+        return x - sz + 1, sz
 
     g_start, g_len = span_of(g)
     # R' pieces: R's children in order with pivot slot -> G span; then R.
     rp_starts, rp_lens = [], []
     for i in range(MAX_ARITY):
-        in_use = i < tree.arity[r]
-        ci = child[r, i]
+        in_use = i < arity_r
+        ci = row_r[i]
         ci_start, ci_len = span_of(ci)
         st = jnp.where(i == pi, g_start, ci_start)
         ln = jnp.where(i == pi, g_len, ci_len)
@@ -471,8 +498,8 @@ def rotate_tree(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     starts.append(jnp.int32(0))
     lens.append(span_start)
     for j in range(MAX_ARITY):
-        in_use = j < tree.arity[p]
-        cj = child[p, j]
+        in_use = j < arity_p
+        cj = row_p[j]
         cj_start, cj_len = span_of(cj)
         is_g = j == gi
         # three sub-pieces: either the R' triple, or (child span, 0, 0)

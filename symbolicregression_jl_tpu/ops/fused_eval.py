@@ -366,13 +366,13 @@ def _make_program_kernel(
 
     def kernel(*refs):
         if nparam > 0:
-            (instr_ref, nstep_ref, nconst_ref, cvals_ref, ok_ref,
+            (instr_ref, nstep_ref, cvals_ref, ok_ref,
              pbank_ref,  # SMEM [TB, NP * NC] f32 — per-tree param banks
              x_ref, clsoh_ref,  # VMEM [NC, TILE] f32 class one-hots
              y_ref, w_ref, mask_ref,
              loss_ref, valid_ref, buf_ref) = refs
         else:
-            (instr_ref, nstep_ref, nconst_ref, cvals_ref, ok_ref,
+            (instr_ref, nstep_ref, cvals_ref, ok_ref,
              x_ref, y_ref, w_ref, mask_ref,
              loss_ref, valid_ref, buf_ref) = refs
         j = pl.program_id(1)
@@ -399,12 +399,15 @@ def _make_program_kernel(
                                      * pbank_ref[t, p_i * nclass + c])
                     buf_ref[nfeat + p_i, :] = row
 
-            def cbody(c, _):
+            # Static-unrolled const preload: at nconst == cmax the
+            # dynamic fori_loop(0, nconst) costs ~420 ns/tree of scalar
+            # loop bookkeeping (profiling/kernel_variants.py `custatic`,
+            # 1.21x); evolved programs average 2-3 consts so the
+            # in-engine effect is neutral-to-positive. Rows past nconst
+            # hold zero-padding and are never addressed.
+            for c in range(cmax):
                 buf_ref[CBASE + c, :] = jnp.full(
                     (tile,), cvals_ref[t, c], dtype=y_row.dtype)
-                return 0
-
-            jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
 
             def step(k, vmask):
                 val = _fwd_dispatch(
@@ -487,7 +490,6 @@ def fused_loss_program(
 
     instr = pad_t(_pack_instr(prog, operators, BASE + L))
     nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
-    nconst = pad_t(prog.nconst.reshape(-1, 1))
     cvals = pad_t(prog.cvals).astype(dtype)
     ok = pad_t(prog.const_ok.astype(jnp.int32).reshape(-1, 1), fill=1)
 
@@ -510,12 +512,11 @@ def fused_loss_program(
     in_specs = [
         smem_i32((TB, L)),                       # instr
         smem_i32((TB, 1)),                       # nsteps
-        smem_i32((TB, 1)),                       # nconst
         pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0),
                      memory_space=pltpu.SMEM),   # cvals
         smem_i32((TB, 1)),                       # const_ok
     ]
-    operands = [instr, nsteps, nconst, cvals, ok]
+    operands = [instr, nsteps, cvals, ok]
     if NP > 0:
         in_specs.append(pl.BlockSpec((TB, NP * NC), lambda i, j: (i, 0),
                                      memory_space=pltpu.SMEM))  # pbank
@@ -607,6 +608,11 @@ def _make_multi_kernel(
             buf_ref[BASE + L, :, :] = jnp.zeros((V, tile), bdt)
 
         for t in range(tree_block):
+            # Dynamic const preload: the single-variant kernels win by
+            # static-unrolling this loop, but here the V-variant stores
+            # already amortize the scalar loop bookkeeping and the
+            # stacked-scalar broadcast variant measured SLOWER (phase
+            # optimizer 4.61 -> 4.89 s/iter; profiling/RESULTS.md r4).
             def cbody(c, _):
                 for v in range(V):
                     buf_ref[nfeat + c, v, :] = jnp.full(
@@ -988,6 +994,9 @@ def _make_multi_grad_kernel(
             buf_ref[BASE + L, :, :] = jnp.zeros((V, tile), y_row.dtype)
 
         for t in range(tree_block):
+            # Dynamic const preload (see _make_multi_kernel's note); the
+            # ADJOINT reduce below is also dynamic — rows past nconst
+            # hold stale adjoints from earlier trees.
             def cbody(c, _):
                 for v in range(V):
                     buf_ref[nfeat + c, v, :] = jnp.full(
@@ -1318,7 +1327,6 @@ def _make_program_predict_kernel(
     def kernel(
         instr_ref,   # SMEM [TB, L]
         nstep_ref,   # SMEM [TB, 1]
-        nconst_ref,  # SMEM [TB, 1]
         cvals_ref,   # SMEM [TB, CMAX] f32
         ok_ref,      # SMEM [TB, 1] int32
         x_ref,       # VMEM [F, TILE] or [TB, F, TILE]
@@ -1342,12 +1350,10 @@ def _make_program_predict_kernel(
             if per_member:
                 buf_ref[0:nfeat, :] = x_ref[t]
 
-            def cbody(c, _):
+            # static-unrolled const preload (see the program kernel)
+            for c in range(cmax):
                 buf_ref[nfeat + c, :] = jnp.full(
                     (tile,), cvals_ref[t, c], dtype=dtype)
-                return 0
-
-            jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
 
             def step(k, vmask):
                 val = _fwd_dispatch(
@@ -1439,7 +1445,6 @@ def fused_predict_program(
         in_specs=[
             smem_i32((TB, L)),
             smem_i32((TB, 1)),
-            smem_i32((TB, 1)),
             pl.BlockSpec((TB, CMAX), lambda i, j: (i, 0),
                          memory_space=pltpu.SMEM),
             smem_i32((TB, 1)),
@@ -1457,7 +1462,7 @@ def fused_predict_program(
         ],
         scratch_shapes=[pltpu.VMEM((BASE + L + ZR, TILE), dtype)],
         interpret=interpret,
-    )(instr, nsteps, nconst, cvals, ok, Xp, maskp)
+    )(instr, nsteps, cvals, ok, Xp, maskp)
 
     return pred[:T, :n], valid[:T, 0].astype(jnp.bool_)
 
@@ -1542,12 +1547,10 @@ def _make_program_predict_vjp_kernel(
             if per_member:
                 buf_ref[0:nfeat, :] = x_ref[t]
 
-            def cbody(c, _):
+            # static-unrolled const preload (see the program kernel)
+            for c in range(cmax):
                 buf_ref[nfeat + c, :] = jnp.full(
                     (tile,), cvals_ref[t, c], dtype=dtype)
-                return 0
-
-            jax.lax.fori_loop(0, nconst_ref[t, 0], cbody, 0)
 
             def fwd(k, _):
                 buf_ref[BASE + k, :] = _fwd_dispatch(
