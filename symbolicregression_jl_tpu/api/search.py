@@ -338,6 +338,34 @@ def _seed_population(
     return dataclasses.replace(state, pops=pops)
 
 
+def _enable_default_compile_cache() -> None:
+    """Turn on JAX's persistent compilation cache unless the user (or
+    the test harness) configured one already.
+
+    A cold quickstart fit at the device-scale config pays ~3-4 minutes
+    of XLA compiles (the iteration epilogue alone is ~2 minutes);
+    repeat runs with the same shapes load from the cache in seconds.
+    Opt out with SR_NO_COMPILE_CACHE=1 or by setting
+    ``jax_compilation_cache_dir`` yourself.
+    """
+    if os.environ.get("SR_NO_COMPILE_CACHE"):
+        return
+    if jax.config.jax_compilation_cache_dir is not None:
+        return
+    # User-owned cache dir (NOT a predictable /tmp path: the persistent
+    # cache deserializes executables, so the directory must not be
+    # pre-creatable by another local user).
+    base = os.environ.get(
+        "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache"))
+    path = os.path.join(base, "symbolicregression_jl_tpu", "xla_cache")
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:  # unwritable home: skip caching rather than risk /tmp
+        return
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+
 def equation_search(
     X,
     y=None,
@@ -370,6 +398,7 @@ def equation_search(
     ``return_state=True``.
     """
     options = options or Options()
+    _enable_default_compile_cache()
     # Copy so the caller's RuntimeOptions is never mutated (it may be
     # reused across searches).
     ropt = (
@@ -617,7 +646,21 @@ def equation_search(
     n_chunks = min(4, options.ncycles_per_iteration)
 
     def _chunk_sizes():
-        base, rem = divmod(options.ncycles_per_iteration, n_chunks)
+        # EQUAL chunks whose length divides ncycles: uneven splits
+        # (e.g. 13+12) compile one evolve program per distinct length,
+        # and every adaptation of n_chunks would add more — measured as
+        # ~minutes of XLA compiles in a quickstart fit at the
+        # device-scale config. With divisor-sized chunks each
+        # adaptation costs at most one new program, often zero.
+        nc = options.ncycles_per_iteration
+        target = max(nc // n_chunks, 1)
+        length = next((d for d in range(target, nc + 1) if nc % d == 0), nc)
+        if length <= 2 * target or n_chunks == 1:
+            return [length] * (nc // length)
+        # No divisor near the target (prime-ish nc): fall back to
+        # near-equal chunks so mid-iteration budget polling stays live
+        # (two compiled lengths instead of one — still bounded).
+        base, rem = divmod(nc, n_chunks)
         sizes = [base + (1 if c < rem else 0) for c in range(n_chunks)]
         return [c for c in sizes if c > 0]
 
@@ -635,6 +678,7 @@ def equation_search(
     host_t0 = time.time()
 
     it = 0
+    used_chunk_sets = set()
     while it < ropt.niterations and stop_reason is None:
         cur_maxsize = get_cur_maxsize(
             options.maxsize, options.warmup_maxsize_by, total_cycles,
@@ -643,6 +687,8 @@ def equation_search(
         dev_t0 = time.time()
         monitor_host = dev_t0 - host_t0  # bookkeeping since last iteration
         chunk_sizes = _chunk_sizes()
+        fresh_compile = tuple(chunk_sizes) not in used_chunk_sets
+        used_chunk_sets.add(tuple(chunk_sizes))
         iter_events = [None] * len(engines)
         for j, (engine, data) in enumerate(zip(engines, datas)):
             out = engine.run_iteration(
@@ -658,11 +704,14 @@ def equation_search(
         host_t0 = time.time()
         # Adapt chunk count toward the stop-latency target using this
         # iteration's measured device time, quantized to powers of two —
-        # each distinct chunk size compiles its own evolve-part, so the
-        # count must not wander with timing noise. The first iteration's
-        # measurement is dominated by one-time jit compilation and is
-        # skipped.
-        if it >= 1:  # it not yet incremented: 0 == first iteration
+        # each distinct chunk-size set compiles its own evolve program
+        # (tens of seconds at device-scale configs), so the count must
+        # not wander with timing noise, and an iteration that COMPILED a
+        # new set must never feed the adaptation (its wall time is
+        # compile-dominated; adapting off it churned chunk lengths and
+        # recompiled every iteration). The first iteration is skipped
+        # for the same reason.
+        if it >= 1 and not fresh_compile:  # 0 == first iteration
             target = (host_t0 - dev_t0) / _STOP_LATENCY_TARGET_S
             cap = min(options.ncycles_per_iteration, _MAX_CHUNKS)
             n_chunks = 1

@@ -280,12 +280,27 @@ class Engine:
                 k_batch, (cfg.batch_size,), 0, data.y.shape[0]
             )
         pops, birth, ref = state.pops, state.birth, state.ref
-        carry = None
+        # One evolve program serves every chunk: the first chunk gets an
+        # explicit empty carry (the same values s_r_cycle would build
+        # internally) instead of compiling a second carry-less program
+        # variant — at the device-scale config each evolve-program
+        # compile costs tens of seconds, dominating quickstart fits.
+        I = birth.shape[0]
+        P = cfg.population_size
+        hof0 = empty_hof(cfg.maxsize, cfg.max_nodes, pops.cost.dtype,
+                         cfg.n_params, cfg.n_classes,
+                         template_k=(cfg.template.n_subexpressions
+                                     if cfg.template else 0))
+        carry = (
+            jax.tree.map(lambda x: jnp.broadcast_to(x, (I,) + x.shape),
+                         hof0),
+            jnp.zeros((I,), jnp.float32),
+            (jnp.zeros((I, P), jnp.bool_), jnp.zeros((I, P), jnp.bool_)),
+        )
         c0 = 0
         ev_chunks = []
         for i, nc in enumerate(chunk_sizes):
-            fn = self._chunk_fn(nc, first=carry is None,
-                                batching=batch_idx is not None)
+            fn = self._chunk_fn(nc, batching=batch_idx is not None)
             out = fn(
                 pops, birth, ref, state.stats.normalized_frequencies, data,
                 cur_maxsize, k_cycle, batch_idx, jnp.int32(c0), carry
@@ -322,11 +337,11 @@ class Engine:
             return new_state, events
         return new_state
 
-    def _chunk_fn(self, ncycles: int, first: bool, batching: bool):
+    def _chunk_fn(self, ncycles: int, batching: bool):
         """Jitted evolve-chunk for a given (static) chunk length."""
         if not hasattr(self, "_chunk_cache"):
             self._chunk_cache = {}
-        k = (ncycles, first, batching)
+        k = (ncycles, batching)
         if k not in self._chunk_cache:
             cfg = self.cfg._replace(ncycles=ncycles)
             self._chunk_cache[k] = jax.jit(

@@ -1420,7 +1420,6 @@ def fused_predict_program(
 
     instr = pad_t(_pack_instr(prog, operators, BASE + L))
     nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
-    nconst = pad_t(prog.nconst.reshape(-1, 1))
     cvals = pad_t(prog.cvals).astype(dtype)
     ok = pad_t(prog.const_ok.astype(jnp.int32).reshape(-1, 1), fill=1)
 
