@@ -1,0 +1,74 @@
+"""True per-iteration optimizer cost via shared-start-state A/B.
+
+The A-B phase split in phase_timing.py compares two *chained* runs, so
+the with-optimizer and without-optimizer populations diverge and the
+difference conflates optimizer cost with evolution divergence. Here
+both engines run ONE iteration from the SAME warmed state (copied
+first — run_iteration donates its state arg), so the diff is the
+optimizer block alone (+ the finalize re-eval's constant values, same
+shapes/cost).
+
+Round-5 result (512x256x100c, bench problem): per-iteration optimizer
+cost oscillates 1.3-8.3 s with the adaptive-parsimony grow/collapse
+cycle of the population (mean tree length swings ~5 <-> ~23); the
+no-opt remainder swings only 2.8-5.0 s. The driver of optimizer cost
+is the selected trees' program length at epilogue time, not any
+kernel-plan inefficiency (see opt_bench.py sweeps: V-chunk, tile
+budget, tree_block, pass-count variants all within +-2%).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from _common import make_bench_problem
+
+
+def main():
+    I = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    P = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    NC = int(sys.argv[3]) if len(sys.argv) > 3 else 100
+    iters = int(sys.argv[4]) if len(sys.argv) > 4 else 6
+
+    from symbolicregression_jl_tpu import search_key
+
+    kw = dict(populations=I, population_size=P, ncycles_per_iteration=NC,
+              tournament_selection_n=16)
+    optA, ds, engA = make_bench_problem(**kw)
+    optB, _, engB = make_bench_problem(should_optimize_constants=False, **kw)
+    copy = jax.jit(lambda s: jax.tree.map(jnp.copy, s))
+
+    state = engA.init_state(search_key(0), ds.data, I)
+    state = engA.run_iteration(copy(state), ds.data, optA.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    sB = engB.run_iteration(copy(state), ds.data, optB.maxsize)  # warm B
+    jax.block_until_ready(sB.pops.cost)
+
+    for it in range(2, 2 + iters):
+        ml = float(jnp.mean(state.pops.trees.length))
+        sc = copy(state)
+        jax.block_until_ready(sc.pops.cost)
+        t0 = time.perf_counter()
+        sA = engA.run_iteration(sc, ds.data, optA.maxsize)
+        jax.block_until_ready(sA.pops.cost)
+        tA = time.perf_counter() - t0
+        sc = copy(state)
+        jax.block_until_ready(sc.pops.cost)
+        t0 = time.perf_counter()
+        sB = engB.run_iteration(sc, ds.data, optB.maxsize)
+        jax.block_until_ready(sB.pops.cost)
+        tB = time.perf_counter() - t0
+        print(f"iter {it}: A {tA:6.3f}s  B(no-opt) {tB:6.3f}s  "
+              f"opt {tA - tB:6.3f}s  (start mean len {ml:5.1f})")
+        state = sA
+
+
+if __name__ == "__main__":
+    main()

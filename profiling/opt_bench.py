@@ -1,0 +1,166 @@
+"""Standalone optimizer-phase bench: times `optimize_constants_fused` on
+the realistic selected batch of the bench config (512 islands x 256
+members, k_sel=36 -> 18,432 trees), sweeping the kernel launch plan
+(V-chunks, VMEM tile budgets, tree_block).
+
+The trees come from one real evolved iteration so program lengths and
+constant counts match what the engine actually optimizes. Timing is
+dependency-chained (each call's new constants feed the next call);
+evals/s uses the same f_calls accounting as the engine.
+
+Usage: opt_bench.py [n_iters] [n_chain] [--exact]
+  n_iters: evolution iterations before selecting the batch (tree length
+           grows/oscillates with this; 1 -> mean len ~9, 4 -> ~16)
+  n_chain: timed dependency-chained launches per config
+  --exact: also compare early_exit on/off outputs (NOT expected to be
+           bit-identical: a failed row's zero history pair resets the
+           two-loop gamma, so the un-frozen row can recover; this mode
+           measures how far the trajectories drift and the live-row
+           decay)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from _common import make_bench_problem
+
+
+def build_selected_batch(I=512, P=256, NC=100, n_iters=3):
+    """A few evolved iterations (steady-state tree lengths), then the
+    epilogue's top-k selection."""
+    from symbolicregression_jl_tpu import search_key
+
+    options, ds, engine = make_bench_problem(
+        populations=I, population_size=P, ncycles_per_iteration=NC,
+        tournament_selection_n=16)
+    state = engine.init_state(search_key(0), ds.data, I)
+    for _ in range(n_iters):
+        state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+
+    k_sel = max(1, round(P * options.optimizer_probability))
+    scores = jax.random.uniform(jax.random.PRNGKey(7), (I, P))
+    _, sel_idx = jax.lax.top_k(scores, k_sel)
+    sub = jax.vmap(
+        lambda t, i: jax.tree.map(lambda x: jnp.take(x, i, axis=0), t)
+    )(state.pops.trees, sel_idx)
+    flat = jax.tree.map(
+        lambda x: x.reshape((I * k_sel,) + x.shape[2:]), sub)
+    return options, ds, engine, flat
+
+
+def time_cfg(options, ds, engine, flat, cfg, n_chain=3):
+    from symbolicregression_jl_tpu.evolve.constant_opt import (
+        optimize_constants_fused)
+
+    M = flat.arity.shape[0]
+    do_opt = jnp.ones((M,), bool)
+    key = jax.random.PRNGKey(3)
+
+    @jax.jit
+    def one(const):
+        t = dataclasses.replace(flat, const=const)
+        new_const, improved, new_loss, f_calls = optimize_constants_fused(
+            key, t, do_opt, ds.data, options.elementwise_loss,
+            engine.cfg.operators, cfg)
+        return new_const, f_calls
+
+    const = flat.const
+    new_const, f_calls = one(const)          # compile + warmup
+    jax.block_until_ready(new_const)
+    t0 = time.perf_counter()
+    c = new_const
+    for _ in range(n_chain):
+        c, f_calls = one(c)
+    jax.block_until_ready(c)
+    dt = (time.perf_counter() - t0) / n_chain
+    ev = float(jnp.sum(f_calls))
+    return dt, ev
+
+
+def check_exact(options, ds, engine, flat):
+    """Compare early_exit on/off outputs and print the live-row decay.
+
+    NOT expected to be bit-identical (see module docstring); the
+    interesting outputs are how many rows stay live per iteration and
+    how much the frozen trajectories drift."""
+    from symbolicregression_jl_tpu.evolve.constant_opt import (
+        OptimizerConfig, optimize_constants_fused)
+
+    M = flat.arity.shape[0]
+    do_opt = jnp.ones((M,), bool)
+    key = jax.random.PRNGKey(3)
+    outs = {}
+    for name, cfg in (("off", OptimizerConfig(early_exit=False)),
+                      ("on", OptimizerConfig(early_exit=True))):
+        outs[name] = optimize_constants_fused(
+            key, flat, do_opt, ds.data, options.elementwise_loss,
+            engine.cfg.operators, cfg, return_diag=True)
+    c_eq = bool(jnp.array_equal(outs["off"][0], outs["on"][0]))
+    i_eq = bool(jnp.array_equal(outs["off"][1], outs["on"][1]))
+    l_eq = bool(jnp.array_equal(outs["off"][2], outs["on"][2]))
+    tr = [int(v) for v in outs["on"][4]]
+    print(f"outputs equal (drift check): const={c_eq} improved={i_eq} "
+          f"loss={l_eq}")
+    print(f"live rows/iteration (of {3 * M}): {tr}")
+    print(f"f_calls: off {float(jnp.sum(outs['off'][3])):.0f}  "
+          f"on {float(jnp.sum(outs['on'][3])):.0f}")
+    return c_eq and i_eq and l_eq
+
+
+def main():
+    pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+    n_iters = int(pos[0]) if len(pos) > 0 else 3
+    n_chain = int(pos[1]) if len(pos) > 1 else 3
+    from symbolicregression_jl_tpu.evolve.constant_opt import OptimizerConfig
+
+    options, ds, engine, flat = build_selected_batch(n_iters=n_iters)
+    M = flat.arity.shape[0]
+    print(f"selected batch: {M} trees, "
+          f"mean length {float(jnp.mean(flat.length)):.1f}")
+
+    if "--exact" in sys.argv:
+        check_exact(options, ds, engine, flat)
+
+    MB = 2**20
+    configs = [
+        ("baseline (ls 3x2=6 passes)", OptimizerConfig()),
+        ("early_exit on", OptimizerConfig(early_exit=True)),
+        ("ls V24 @12.5MB (1x4=4 passes)", OptimizerConfig(
+            ls_v_chunk=24, ls_tile_budget=int(12.5 * MB))),
+        ("TB16", OptimizerConfig(tree_block=16)),
+        ("TB32", OptimizerConfig(tree_block=32)),
+        ("ls V24 + TB16", OptimizerConfig(
+            ls_v_chunk=24, ls_tile_budget=int(12.5 * MB), tree_block=16)),
+        ("gr @9MB", OptimizerConfig(grad_tile_budget=9 * MB)),
+    ]
+
+    results = []
+    for name, cfg in configs:
+        try:
+            dt, ev = time_cfg(options, ds, engine, flat, cfg, n_chain)
+            rate = ev / dt
+            results.append((name, dt, rate))
+            print(f"{name:42s} {dt:7.3f} s/launch  {rate:10.0f} ev/s")
+        except Exception as e:  # VMEM OOM etc.
+            print(f"{name:42s} FAILED: {type(e).__name__}: "
+                  f"{str(e)[:200]}")
+    if not results:
+        print("\nall configs failed")
+        return
+    best = min(results, key=lambda r: r[1])
+    print(f"\nbest: {best[0]}  {best[1]:.3f} s/launch ({best[2]:.0f} ev/s)")
+
+
+if __name__ == "__main__":
+    main()

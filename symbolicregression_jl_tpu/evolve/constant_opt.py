@@ -14,6 +14,7 @@ path serves all arities.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple, Optional
 
 import jax
@@ -40,6 +41,27 @@ class OptimizerConfig(NamedTuple):
     # only pick the step size, and the accepted point is re-verified at
     # f32 (descent guard in `optimize_constants_fused`).
     ls_bf16: bool = False
+    # Kernel launch plan for the fused path (see profiling/opt_bench.py
+    # for the sweep behind these defaults): V-chunk sizes and VMEM
+    # budgets for the line-search (`fused_loss_multi`) and gradient
+    # (`fused_grad_multi`) kernels. `None` = the kernels' own defaults.
+    ls_v_chunk: Optional[int] = None
+    ls_tile_budget: Optional[int] = None
+    grad_v_chunk: Optional[int] = None
+    grad_tile_budget: Optional[int] = None
+    tree_block: Optional[int] = None
+    # Demote line-search-failed rows to 1-step programs (fused path),
+    # freezing them for the remaining iterations, and skip members with
+    # no constant leaves entirely; f_calls counts only live rows
+    # (reference analogue: Optim.jl's convergence stop,
+    # src/ConstantOptimization.jl:86-100). Default OFF: measured on the
+    # bench config, <5% of rows ever fail their breadth-C line search
+    # (profiling/opt_bench.py), so the saving is marginal — and a failed
+    # row is NOT exactly dead in this implementation (the pushed zero
+    # pair resets the two-loop gamma scaling to 1, so the next direction
+    # differs and can recover), making the freeze a slight semantic
+    # deviation as well.
+    early_exit: bool = False
 
 
 def _bfgs_minimize(f, x0, mask, cfg: OptimizerConfig):
@@ -115,6 +137,7 @@ def optimize_constants_fused(
     cfg: OptimizerConfig,
     batch_idx: Optional[jax.Array] = None,
     interpret: bool = False,
+    return_diag: bool = False,
 ):
     """TPU-shaped BFGS: the line search is batched *across* members and
     candidate step sizes into one fused-kernel launch per BFGS iteration
@@ -160,14 +183,28 @@ def optimize_constants_fused(
     x = starts.reshape(P * R, CM)
     mask_r = jnp.repeat(used, R, axis=0)  # [P*R, CM]
 
-    def vg(consts):  # [P*R, CM] -> (loss [P*R], grad [P*R, CM])
+    grad_kw = dict(interpret=interpret)
+    if cfg.grad_v_chunk is not None:
+        grad_kw["v_chunk"] = cfg.grad_v_chunk
+    if cfg.grad_tile_budget is not None:
+        grad_kw["tile_budget"] = cfg.grad_tile_budget
+    ls_kw = dict(interpret=interpret)
+    if cfg.ls_v_chunk is not None:
+        ls_kw["v_chunk"] = cfg.ls_v_chunk
+    if cfg.ls_tile_budget is not None:
+        ls_kw["tile_budget"] = cfg.ls_tile_budget
+    if cfg.tree_block is not None:
+        grad_kw["tree_block"] = cfg.tree_block
+        ls_kw["tree_block"] = cfg.tree_block
+
+    def vg(consts, pg):  # [P*R, CM] -> (loss [P*R], grad [P*R, CM])
         # R restart variants of one tree share the multi-variant grad
         # kernel's variants axis (same dispatch-amortization as the line
         # search below).
         cv = consts.reshape(P, R, CM)
         loss, _, gcomp = fused_grad_multi(
-            prog, cv, X, y, w, F, operators, elementwise_loss,
-            interpret=interpret,
+            pg, cv, X, y, w, F, operators, elementwise_loss,
+            **grad_kw,
         )
         grad = gcomp.reshape(P * R, CM)
         return loss.reshape(P * R), jnp.where(mask_r, grad, 0.0)
@@ -175,19 +212,25 @@ def optimize_constants_fused(
     ts = cfg.shrink ** jnp.arange(cfg.max_linesearch, dtype=x.dtype)  # [C]
     C = cfg.max_linesearch
 
-    def fused_many(cand_x):  # [P*R, C, CM] -> loss [P*R, C]
+    def fused_many(cand_x, pg):  # [P*R, C, CM] -> loss [P*R, C]
         # All R*C constant variants of one tree ride the multi-variant
         # kernel's variants axis: ONE instruction-stream dispatch per
         # tree instead of R*C replicated trees (the per-step scalar
         # dispatch is the dominant kernel cost).
         cv = cand_x.reshape(P, R * C, CM)
         loss, _ = fused_loss_multi(
-            prog, cv, X, y, w, F, operators, elementwise_loss,
-            bf16=cfg.ls_bf16, interpret=interpret)
+            pg, cv, X, y, w, F, operators, elementwise_loss,
+            bf16=cfg.ls_bf16, **ls_kw)
         return loss.reshape(P * R, C)
 
-    fx0, g0 = vg(x)
+    fx0, g0 = vg(x, prog)
     calls0 = jnp.ones((P * R,), jnp.float32)
+    # Early-exit bookkeeping: rows start live unless the member is
+    # gated off or the tree has no constants to optimize.
+    if cfg.early_exit:
+        active0 = jnp.repeat(do_opt & (prog.nconst > 0), R)
+    else:
+        active0 = jnp.ones((P * R,), jnp.bool_)
 
     # L-BFGS two-loop recursion instead of dense-H BFGS: the [m, L, L]
     # Hessian-approximation updates dominated optimizer time on TPU (tiny
@@ -221,7 +264,16 @@ def optimize_constants_fused(
         return -q
 
     def bfgs_iter(carry, _):
-        x, fx, g, S, Y, rho, calls = carry
+        x, fx, g, S, Y, rho, calls, active = carry
+        if cfg.early_exit:
+            # Trees with every restart row dead run 1-step programs in
+            # both kernels (per-tree dynamic trip counts); their outputs
+            # are garbage and fully gated out below via ``active``.
+            tree_live = jnp.any(active.reshape(P, R), axis=1)
+            pg = dataclasses.replace(
+                prog, nsteps=jnp.where(tree_live, prog.nsteps, 1))
+        else:
+            pg = prog
         d = lbfgs_direction(g, S, Y, rho)
         dg = jnp.sum(d * g, axis=1)
         use_sd = (dg >= 0) | ~jnp.all(jnp.isfinite(d), axis=1)
@@ -230,16 +282,16 @@ def optimize_constants_fused(
 
         # all candidate steps in ONE fused launch: [P*R, C, CM]
         cand_x = x[:, None, :] + ts[None, :, None] * d[:, None, :]
-        f_cand = fused_many(cand_x)
+        f_cand = fused_many(cand_x, pg)
         armijo = (
             f_cand <= fx[:, None] + cfg.c1 * ts[None, :] * dg[:, None]
         ) & jnp.isfinite(f_cand)
-        any_ok = jnp.any(armijo, axis=1)
+        any_ok = jnp.any(armijo, axis=1) & active
         first = jnp.argmax(armijo, axis=1)
         t_star = jnp.where(any_ok, ts[first], 0.0)
         s = t_star[:, None] * d
         x_new = x + s
-        f_new, g_new = vg(x_new)
+        f_new, g_new = vg(x_new, pg)
         # Descent guard at f32: with an exact line search Armijo already
         # implies f_new < fx, but bf16 candidate losses (~3 significant
         # digits) can accept a step that is uphill at full precision —
@@ -257,10 +309,13 @@ def optimize_constants_fused(
         S = jnp.concatenate([s[None], S[:-1]], axis=0)
         Y = jnp.concatenate([yv[None], Y[:-1]], axis=0)
         rho = jnp.concatenate([rho_new[None], rho[:-1]], axis=0)
-        return (x_new, f_new, g_new, S, Y, rho, calls + C + 1), None
+        calls = calls + (C + 1) * active.astype(calls.dtype)
+        new_active = any_ok if cfg.early_exit else active
+        return (x_new, f_new, g_new, S, Y, rho, calls, new_active), (
+            jnp.sum(active) if return_diag else jnp.zeros((), jnp.int32))
 
-    (x, fx, g, _, _, _, calls), _ = jax.lax.scan(
-        bfgs_iter, (x, fx0, g0, S0, Y0, rho0, calls0), None,
+    (x, fx, g, _, _, _, calls, _), diag = jax.lax.scan(
+        bfgs_iter, (x, fx0, g0, S0, Y0, rho0, calls0, active0), None,
         length=cfg.iterations,
     )
 
@@ -278,7 +333,10 @@ def optimize_constants_fused(
         x_best, mode="drop")
     new_const = jnp.where(improved[:, None], scattered, trees.const)
     f_calls = jnp.sum(calls.reshape(P, R), axis=1) * do_opt
-    return new_const, improved, jnp.where(improved, f_best, baseline), f_calls
+    out = (new_const, improved, jnp.where(improved, f_best, baseline), f_calls)
+    if return_diag:
+        return out + (diag,)   # [iterations] live-row counts
+    return out
 
 
 def optimize_constants_template(

@@ -663,7 +663,7 @@ def _make_multi_kernel(
     jax.jit,
     static_argnames=(
         "nfeatures", "operators", "loss_fn", "tree_block", "bf16",
-        "interpret", "tile_budget",
+        "interpret", "tile_budget", "v_chunk",
     ),
 )
 def fused_loss_multi(
@@ -680,6 +680,7 @@ def fused_loss_multi(
     bf16: bool = False,
     interpret: bool = False,
     tile_budget: int = 8 * 2**20,
+    v_chunk: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Mean loss for every (tree, constant-variant) pair: [T, V] each.
 
@@ -710,17 +711,20 @@ def fused_loss_multi(
     bytes_per = jnp.dtype(buf_dtype).itemsize
 
     # Chunks of 8 (f32) / 16 (bf16): the obvious "fewer dispatch passes"
-    # alternatives were measured NEUTRAL-or-worse on the bench — one f32
-    # V=24 chunk at 2.5k-row tiles (4 passes vs 6) lands within noise of
-    # this plan (per-pass fixed costs offset the saved dispatches), and
-    # bf16 V=16 chunks lose outright to per-step bf16<->f32 relayouts.
-    VCH = 16 if bf16 else 8
+    # alternatives were measured NEUTRAL-or-worse on the bench at the
+    # 8 MB budget — one f32 V=24 chunk at 2.5k-row tiles (4 passes vs 6)
+    # lands within noise of this plan (per-pass fixed costs offset the
+    # saved dispatches), and bf16 V=16 chunks lose outright to per-step
+    # bf16<->f32 relayouts. ``v_chunk`` overrides for callers that pair
+    # it with a larger ``tile_budget`` (see OptimizerConfig).
+    VCH = v_chunk if v_chunk is not None else (16 if bf16 else 8)
     if V > VCH:
         outs = [
             fused_loss_multi(
                 prog, cvals_v[:, v0:v0 + VCH], X, y, weights, nfeatures,
                 operators, loss_fn, tree_block=tree_block, bf16=bf16,
-                interpret=interpret, tile_budget=tile_budget)
+                interpret=interpret, tile_budget=tile_budget,
+                v_chunk=v_chunk)
             for v0 in range(0, V, VCH)
         ]
         return (jnp.concatenate([o[0] for o in outs], axis=1),
@@ -1078,6 +1082,7 @@ def _make_multi_grad_kernel(
     jax.jit,
     static_argnames=(
         "nfeatures", "operators", "loss_fn", "tree_block", "interpret",
+        "tile_budget", "v_chunk",
     ),
 )
 def fused_grad_multi(
@@ -1092,6 +1097,8 @@ def fused_grad_multi(
     *,
     tree_block: int = 8,
     interpret: bool = False,
+    tile_budget: int = 8 * 2**20,
+    v_chunk: int = 4,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(loss [T, V], valid [T, V], dloss/dcvals [T, V, CMAX]) per
     (tree, constant-variant) pair — one instruction dispatch per tree.
@@ -1100,13 +1107,14 @@ def fused_grad_multi(
     (BASE+L) x V x TILE scratch buffers, so it hits the VMEM ceiling at
     half the variant count)."""
     V = cvals_v.shape[1]
-    if V > 4:
+    if V > v_chunk:
         outs = [
             fused_grad_multi(
-                prog, cvals_v[:, v0:v0 + 4], X, y, weights, nfeatures,
+                prog, cvals_v[:, v0:v0 + v_chunk], X, y, weights, nfeatures,
                 operators, loss_fn, tree_block=tree_block,
-                interpret=interpret)
-            for v0 in range(0, V, 4)
+                interpret=interpret, tile_budget=tile_budget,
+                v_chunk=v_chunk)
+            for v0 in range(0, V, v_chunk)
         ]
         return (jnp.concatenate([o[0] for o in outs], axis=1),
                 jnp.concatenate([o[1] for o in outs], axis=1),
@@ -1123,7 +1131,7 @@ def fused_grad_multi(
     bytes_per = jnp.dtype(dtype).itemsize
     ZR = _zero_rows(operators)
     TILE = _pick_tile(n, n, 2 * (BASE + L + ZR) * V, bytes_per,
-                      budget=8 * 2**20)
+                      budget=tile_budget)
     T_pad = _round_up(T, TB)
     n_pad = _round_up(n, TILE)
 
