@@ -352,6 +352,12 @@ def _enable_default_compile_cache() -> None:
         return
     if jax.config.jax_compilation_cache_dir is not None:
         return
+    # Respect a user-tuned cache threshold: only overwrite the value if
+    # it still sits at JAX's own default (1.0s).
+    min_secs_default = (
+        getattr(jax.config, "jax_persistent_cache_min_compile_time_secs", 1.0)
+        == 1.0
+    )
     # User-owned cache dir (NOT a predictable /tmp path: the persistent
     # cache deserializes executables, so the directory must not be
     # pre-creatable by another local user).
@@ -363,7 +369,8 @@ def _enable_default_compile_cache() -> None:
     except OSError:  # unwritable home: skip caching rather than risk /tmp
         return
     jax.config.update("jax_compilation_cache_dir", path)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    if min_secs_default:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 
 def equation_search(
@@ -396,6 +403,13 @@ def equation_search(
     (src/SymbolicRegression.jl:359-474) with TPU-native execution. Returns
     the hall of fame (list for multi-output), or ``(state, hof)`` when
     ``return_state=True``.
+
+    Process-global side effect: unless opted out (SR_NO_COMPILE_CACHE=1)
+    or already configured, the first call enables JAX's persistent
+    compilation cache for the whole process (``jax_compilation_cache_dir``
+    under ``~/.cache``; ``jax_persistent_cache_min_compile_time_secs`` is
+    raised to 2.0s only if still at JAX's default) — this also affects
+    unrelated JAX code running in the same process.
     """
     options = options or Options()
     _enable_default_compile_cache()
@@ -655,7 +669,13 @@ def equation_search(
         nc = options.ncycles_per_iteration
         target = max(nc // n_chunks, 1)
         length = next((d for d in range(target, nc + 1) if nc % d == 0), nc)
-        if length <= 2 * target or n_chunks == 1:
+        # Divisor-sized chunks only while they also keep the chunk COUNT
+        # bounded (<= 2*n_chunks): when n_chunks outgrows nc's divisor
+        # structure the search above degenerates to tiny (even length-1)
+        # chunks, multiplying host dispatch/poll overhead far beyond the
+        # requested granularity — fall back to near-equal chunks then.
+        if n_chunks == 1 or (length <= 2 * target
+                             and nc // length <= 2 * n_chunks):
             return [length] * (nc // length)
         # No divisor near the target (prime-ish nc): fall back to
         # near-equal chunks so mid-iteration budget polling stays live

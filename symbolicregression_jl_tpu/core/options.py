@@ -331,6 +331,12 @@ class Options:
         save_to_file: bool = True,
         use_recorder: bool = False,
         recorder_file: str = "recorder.json",
+        # 1: accepted events + per-kind aggregate rejection counts;
+        # >=2: every rejected candidate becomes its own event with its
+        # reason (constraint / invalid / annealing), matching the
+        # reference's per-mutation tmp_recorder detail
+        # (src/RegularizedEvolution.jl:47-75, src/Mutate.jl:270-355).
+        recorder_verbosity: int = 1,
         # TPU-specific extensions:
         eval_dtype: str = "float32",
         mutation_attempts: int = 5,  # speculative batch width (reference's
@@ -494,6 +500,7 @@ class Options:
         self.save_to_file = bool(save_to_file)
         self.use_recorder = bool(use_recorder)
         self.recorder_file = recorder_file
+        self.recorder_verbosity = int(recorder_verbosity)
 
         self.eval_dtype = eval_dtype
         self.mutation_attempts = int(mutation_attempts)

@@ -77,6 +77,13 @@ class MutationContext(NamedTuple):
     perturbation_factor: float
     probability_negate_constant: float
     n_params: int = 0      # static; >0 => parametric leaf sampling
+    # Route concat_pieces' int-field takes through a one-hot MXU matmul
+    # instead of the where+masked-sum contraction. Wins ~3x per cycle at
+    # small mutation batches (reference-scale configs) where XLA gives
+    # the vmapped masked-sum a pathological layout; loses at the
+    # bench-scale batches where the masked-sum lowering is already
+    # efficient. Set from the static batch size in EvolveConfig.mctx.
+    int_take_matmul: bool = False
 
 
 _SCRATCH_NU = 4 * MAX_ARITY  # uniforms consumed by _make_leaf_scratch
@@ -246,7 +253,8 @@ def swap_operands(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     sources = (tree.arity, tree.op, tree.feat, tree.const)
     starts = jnp.stack([jnp.int32(0), s2, s1, k_node, k_node + 1])
     lens = jnp.stack([s1, l2, l1, jnp.int32(1), tree.length - (k_node + 1)])
-    new_tree, ok = concat_pieces(sources, starts, lens, L)
+    new_tree, ok = concat_pieces(sources, starts, lens, L,
+                                 int_matmul=ctx.int_take_matmul)
     return _select_tree(has_any, new_tree, tree), ok | ~has_any
 
 
@@ -265,7 +273,8 @@ def delete_node(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     carry_start, carry_len = _span(size, carry)
     sources = (tree.arity, tree.op, tree.feat, tree.const)
     new_tree, ok = splice_span(
-        tree, node_start, k_node, sources, carry_start, carry_len, L
+        tree, node_start, k_node, sources, carry_start, carry_len, L,
+        int_matmul=ctx.int_take_matmul,
     )
     return _select_tree(has_any, new_tree, tree), ok | ~has_any
 
@@ -361,7 +370,8 @@ def _expand_leaf_pieces(tree, scratch, k_node, node_start, node_len, new_arity,
     # suffix
     starts.append(node_start + node_len)
     lens.append(tree.length - (node_start + node_len))
-    return concat_pieces(sources, jnp.stack(starts), jnp.stack(lens), L)
+    return concat_pieces(sources, jnp.stack(starts), jnp.stack(lens), L,
+                         int_matmul=ctx.int_take_matmul)
 
 
 def _write_op_slot(scratch, a, o):
@@ -515,7 +525,8 @@ def rotate_tree(u, tree: TreeBatch, ctx: MutationContext, structure=None):
     lens.append(tree.length - (span_start + span_len))
 
     sources = (tree.arity, tree.op, tree.feat, tree.const)
-    new_tree, ok = concat_pieces(sources, jnp.stack(starts), jnp.stack(lens), L)
+    new_tree, ok = concat_pieces(sources, jnp.stack(starts), jnp.stack(lens), L,
+                                 int_matmul=ctx.int_take_matmul)
     return _select_tree(has_root, new_tree, tree), ok | ~has_root
 
 
@@ -532,9 +543,11 @@ def crossover_trees(u, tree1: TreeBatch, tree2: TreeBatch, ctx: MutationContext,
     s1, l1 = _span(size1, n1)
     s2, l2 = _span(size2, n2)
     sources12 = combine_sources(tree1, tree2)
-    child1, ok1 = splice_span(tree1, s1, n1, sources12, L + s2, l2, L)
+    child1, ok1 = splice_span(tree1, s1, n1, sources12, L + s2, l2, L,
+                              int_matmul=ctx.int_take_matmul)
     sources21 = combine_sources(tree2, tree1)
-    child2, ok2 = splice_span(tree2, s2, n2, sources21, L + s1, l1, L)
+    child2, ok2 = splice_span(tree2, s2, n2, sources21, L + s1, l1, L,
+                              int_matmul=ctx.int_take_matmul)
     return child1, child2, ok1, ok2
 
 

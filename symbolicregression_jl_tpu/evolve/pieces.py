@@ -48,6 +48,7 @@ def concat_pieces(
     starts: jax.Array,  # [NP] int32 — start of each piece in source coords
     lens: jax.Array,    # [NP] int32 — piece lengths (0 = skip)
     max_nodes: int,
+    int_matmul: bool = False,
 ) -> Tuple[TreeBatch, jax.Array]:
     """Build a tree from ordered source pieces.
 
@@ -74,15 +75,47 @@ def concat_pieces(
     # one-hot contraction instead of a dynamic gather
     oh = src[:, None] == jnp.arange(s_arity.shape[0])            # [L, S]
 
-    def take(field, fill):
-        vals = jnp.sum(jnp.where(oh, field, 0), axis=1)
-        return jnp.where(mask, vals, fill).astype(field.dtype)
+    if int_matmul:
+        # The three int fields ride ONE one-hot matmul (MXU, HIGHEST
+        # precision — exact for these small ints, cf.
+        # step._onehot_rows_i): under the mutation machinery's nested
+        # vmap, the where+masked-sum lowering of the int takes gets a
+        # pathological 5-D layout at SMALL batch sizes (~6% lane
+        # utilization + a cross-lane s32 reduce) that dominated
+        # per-cycle cost at reference-scale configs — 0.41 ms/cycle per
+        # call site at 31x27, ~2/3 of the whole cycle; the matmul route
+        # cuts the cycle 3.07 -> 0.98 ms (profiling/trace_machinery.py,
+        # RESULTS.md round 5). At bench-scale batches the masked-sum
+        # lowering is efficient and the matmul LOSES (~20% whole-bench)
+        # — MutationContext picks per config. const always keeps the
+        # masked-sum path: it is never the slow fusion, and the matmul
+        # route would need a NaN/inf clamp that changes
+        # overflowed-constant bits (cf. step._onehot_rows_f).
+        ohf = oh.astype(s_const.dtype)
+        ints = jnp.stack([s_arity, s_op, s_feat], axis=1)        # [S, 3]
+        iout = jnp.round(jnp.matmul(
+            ohf, ints.astype(s_const.dtype),
+            precision=jax.lax.Precision.HIGHEST))                # [L, 3]
 
+        def take_i(col, field):
+            return jnp.where(mask, iout[:, col].astype(field.dtype), 0)
+
+        arity, op, feat = take_i(0, s_arity), take_i(1, s_op), take_i(
+            2, s_feat)
+    else:
+        def take_sum(field):
+            vals = jnp.sum(jnp.where(oh, field, 0), axis=1)
+            return jnp.where(mask, vals, 0).astype(field.dtype)
+
+        arity, op, feat = take_sum(s_arity), take_sum(s_op), take_sum(
+            s_feat)
+
+    cvals = jnp.sum(jnp.where(oh, s_const, 0.0), axis=1)
     tree = TreeBatch(
-        arity=take(s_arity, 0),
-        op=take(s_op, 0),
-        feat=take(s_feat, 0),
-        const=take(s_const, 0.0),
+        arity=arity,
+        op=op,
+        feat=feat,
+        const=jnp.where(mask, cvals, 0.0).astype(s_const.dtype),
         length=jnp.minimum(total, max_nodes).astype(jnp.int32),
     )
     return tree, ok
@@ -96,6 +129,7 @@ def splice_span(
     repl_start: jax.Array,
     repl_len: jax.Array,
     max_nodes: int,
+    int_matmul: bool = False,
 ) -> Tuple[TreeBatch, jax.Array]:
     """Replace ``tree[span_start..span_end]`` with a span from another source.
 
@@ -109,4 +143,5 @@ def splice_span(
     lens = jnp.stack(
         [span_start, repl_len, tree.length - (span_end + 1)]
     )
-    return concat_pieces(replacement_sources, starts, lens, max_nodes)
+    return concat_pieces(replacement_sources, starts, lens, max_nodes,
+                         int_matmul=int_matmul)

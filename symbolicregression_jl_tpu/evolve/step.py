@@ -64,6 +64,21 @@ class CycleEvents(NamedTuple):
     died_ref: jax.Array     # int32 [2B]  (-1 when not accepted)
     accepted: jax.Array     # bool  [2B]
     cost_delta: jax.Array   # f32   [2B]  child cost - parent cost
+    # Why the candidate was rejected (0 = not rejected; 1 = constraint /
+    # no valid candidate; 2 = non-finite cost; 3 = annealing/frequency
+    # rejection — src/Mutate.jl:270-355's check chain). A kept-parent
+    # fallback row (skip_mutation_failures=False) carries BOTH
+    # accepted=True (the parent copy re-enters with a fresh ref) and its
+    # mutation's reject reason, mirroring the reference's "failed
+    # mutation, re-insert member" event.
+    reject_reason: jax.Array  # int32 [2B]
+
+# Mutation-batch row count at or below which concat_pieces' int-field
+# takes use the one-hot MXU matmul. Measured (round 5, forced-on vs
+# forced-off iterations): 310 rows (the reference's 31x27 config) the
+# matmul is 2.5x faster; by 620 rows the masked-sum lowering already
+# wins and keeps winning through the bench config's 40,960 rows.
+_INT_MATMUL_MAX_ROWS = 512
 
 _KIND = {name: i for i, name in enumerate(MUTATION_KINDS)}
 _IMMEDIATE_KINDS = (_KIND["simplify"], _KIND["do_nothing"], _KIND["optimize"],
@@ -112,6 +127,10 @@ class EvolveConfig(NamedTuple):
     # (combiner + per-key arities); trees gain a leading key axis [K, L]
     # and params hold the flat template parameter bank [total, 1].
     template: "object" = None  # Optional[TemplateStructure]
+    # LOCAL island count (post island-sharding) — sizes the per-cycle
+    # mutation batch for static lowering choices (see mctx); 0 = unknown
+    # (ad-hoc EvolveConfig constructions), treated as large.
+    n_islands: int = 0
 
     @property
     def n_slots(self) -> int:
@@ -122,6 +141,11 @@ class EvolveConfig(NamedTuple):
     def mctx(self) -> M.MutationContext:
         # Template parameters live in the structure's parameter vectors,
         # not in tree leaves — no LEAF_PARAM sampling for templates.
+        # The mutation batch is [islands, n_slots, attempts]: below
+        # _INT_MATMUL_MAX_ROWS rows, concat_pieces' int takes route
+        # through the one-hot MXU matmul (profiling/trace_machinery.py;
+        # RESULTS.md round 5 — 3x cycle win at 31x27, loss at 512x256).
+        rows = self.n_islands * self.n_slots * self.attempts
         return M.MutationContext(
             nops=self.operators.nops_tuple(),
             nfeatures=self.nfeatures,
@@ -129,6 +153,7 @@ class EvolveConfig(NamedTuple):
             perturbation_factor=self.perturbation_factor,
             probability_negate_constant=self.probability_negate_constant,
             n_params=0 if self.template is not None else self.n_params,
+            int_take_matmul=0 < rows <= _INT_MATMUL_MAX_ROWS,
         )
 
 
@@ -196,6 +221,7 @@ def evolve_config_from_options(options: Options, nfeatures: int,
         n_classes=n_classes,
         template=template,
         record_events=bool(getattr(options, "use_recorder", False)),
+        n_islands=max(1, options.populations // max(n_island_shards, 1)),
     )
 
 
@@ -942,8 +968,17 @@ def generation_step(
     flat_babies = jax.tree.map(lambda x: x.reshape(nb, *x.shape[2:]), babies)
     order = jnp.argsort(pop.birth)  # oldest first
     rank = jnp.cumsum(flat_replace.astype(jnp.int32)) - 1
+    # When more than P babies replace in one step (possible only when
+    # tournament_n is low enough that 2*n_slots > P), ranks clip to the
+    # same slot; scatter order for colliding indices is UNDEFINED in
+    # XLA, so the superseded rows are routed to the drop slot instead —
+    # the LAST replacement deterministically survives (matching the
+    # reference's sequential oldest-replacement order) and the event
+    # log below agrees with the population by construction.
+    nrep = jnp.sum(flat_replace.astype(jnp.int32))
+    survives = flat_replace & ((rank < P - 1) | (rank == nrep - 1))
     target = jnp.where(
-        flat_replace, order[jnp.clip(rank, 0, P - 1)], P  # P = drop slot
+        survives, order[jnp.clip(rank, 0, P - 1)], P  # P = drop slot
     )
 
     def scatter(dst, src):
@@ -968,6 +1003,16 @@ def generation_step(
         k2_kind = jnp.where(is_xover, XO, -1)
         parent2_1 = jnp.where(is_xover, pop.ref[i2], -1)
         parent_cost2 = jnp.stack([m1_cost, pop.cost[i2]], axis=1)
+        # Rejection reasons (codes in the CycleEvents docstring).
+        mut_reason = jnp.where(
+            ~mut_success, 1,
+            jnp.where(jnp.isnan(after_cost), 2,
+                      jnp.where(~anneal_ok, 3, 0))).astype(jnp.int32)
+        xo_reason = jnp.where(
+            ~xo_success, 1, jnp.where(xo_nan, 2, 0)).astype(jnp.int32)
+        reason1 = jnp.where(
+            is_xover, xo_reason, jnp.where(immediate, 0, mut_reason))
+        reason2 = jnp.where(is_xover, xo_reason, 0)
         events = CycleEvents(
             kind=jnp.stack([k1, k2_kind], axis=1).reshape(-1),
             parent_ref=baby_parent.reshape(-1),
@@ -975,11 +1020,13 @@ def generation_step(
                                   axis=1).reshape(-1),
             child_ref=new_ref,
             died_ref=jnp.where(
-                flat_replace,
+                survives,
                 jnp.take(pop.ref, order[jnp.clip(rank, 0, P - 1)]), -1),
-            accepted=flat_replace,
+            accepted=survives,
             cost_delta=(baby_cost.reshape(-1)
                         - parent_cost2.reshape(-1)),
+            reject_reason=jnp.stack(
+                [reason1, reason2], axis=1).reshape(-1),
         )
     new_pop = PopulationState(
         trees=new_trees,

@@ -42,6 +42,7 @@ class Recorder:
     """Accumulates RecordType-style nested dicts (src/ProgramConstants.jl)."""
 
     def __init__(self, options) -> None:
+        self.verbosity = int(getattr(options, "recorder_verbosity", 1))
         self.record: Dict[str, Any] = {
             "options": repr(options),
             "iterations": [],
@@ -100,12 +101,18 @@ class Recorder:
             }
         )
 
-    @staticmethod
-    def _assemble_events(events) -> List[Dict[str, Any]]:
+    _REASONS = ("none", "constraint", "invalid", "annealing")
+
+    def _assemble_events(self, events) -> List[Dict[str, Any]]:
         """CycleEvents [I, ncycles, 2B] device arrays -> the
         reference-style per-mutation log (accepted events expanded with
-        kind names; rejections kept as per-kind aggregate counts —
-        src/RegularizedEvolution.jl:47-75 records both)."""
+        kind names — src/RegularizedEvolution.jl:47-75 records both
+        accepts and rejects). Rejections: per-(kind, reason) aggregate
+        counts at ``recorder_verbosity`` 1 (default); every rejected
+        candidate becomes its own event (kind, parent, reason) at >= 2.
+        Cost note: at the bench config (512 islands x ~40 candidate
+        rows x 100 cycles) verbosity 2 assembles ~2M more host dicts
+        per iteration — see BASELINE.md."""
         from ..core.options import MUTATION_KINDS
 
         kind = np.asarray(events.kind)
@@ -115,8 +122,13 @@ class Recorder:
         died = np.asarray(events.died_ref)
         accepted = np.asarray(events.accepted)
         delta = np.asarray(events.cost_delta, np.float64)
+        reason = np.asarray(events.reject_reason)
         names = list(MUTATION_KINDS) + ["crossover"]
         I, C, NB = kind.shape
+        # An accepted row must carry a real kind: phantom slot-2 rows
+        # (kind == -1) never replace by construction — names[-1] would
+        # silently mislabel one as "crossover" if that ever regressed.
+        assert (kind[accepted] >= 0).all(), "accepted event with kind=-1"
         out: List[Dict[str, Any]] = []
         rejects: Dict[str, int] = {}
         for isl, cyc, b in zip(*np.nonzero(accepted)):
@@ -133,12 +145,32 @@ class Recorder:
             p2 = int(parent2[isl, cyc, b])
             if k == "crossover" and p2 >= 0:
                 ev["parent2"] = p2
+            r = int(reason[isl, cyc, b])
+            if r > 0:  # kept-parent fallback: accepted AND rejected-why
+                ev["reject_reason"] = self._REASONS[r]
             out.append(ev)
-        rej_kinds, rej_counts = np.unique(
-            kind[~accepted & (kind >= 0)], return_counts=True)
-        rejects = {names[int(k)]: int(c)
-                   for k, c in zip(rej_kinds, rej_counts)}
-        return [{"accepted": out, "rejected_counts": rejects}]
+        rej_mask = ~accepted & (kind >= 0)
+        pairs, pair_counts = np.unique(
+            np.stack([kind[rej_mask], reason[rej_mask]]),
+            axis=1, return_counts=True)
+        rejects = {
+            f"{names[int(k)]}:{self._REASONS[int(r)]}": int(c)
+            for (k, r), c in zip(pairs.T, pair_counts)
+        }
+        result = {"accepted": out, "rejected_counts": rejects}
+        if self.verbosity >= 2:
+            rej_events = [
+                {
+                    "island": int(isl),
+                    "cycle": int(cyc),
+                    "type": names[int(kind[isl, cyc, b])],
+                    "parent": int(parent[isl, cyc, b]),
+                    "reason": self._REASONS[int(reason[isl, cyc, b])],
+                }
+                for isl, cyc, b in zip(*np.nonzero(rej_mask))
+            ]
+            result["rejected"] = rej_events
+        return [result]
 
     def record_final(self, key: str, value: Any) -> None:
         self.record["final_state"][key] = value

@@ -113,6 +113,52 @@ def test_recorder_event_stream_reconstructs_lineage(tmp_path):
         is_initial = e["died"] >= 1_000_000 or e["died"] < 16  # P=16
         assert is_initial or e["died"] < e["child"], e
     assert isinstance(ev_block["rejected_counts"], dict)
+    # verbosity 1 (default): no per-event rejection records
+    assert "rejected" not in ev_block
+
+
+def test_recorder_verbosity2_rejection_events(tmp_path):
+    """recorder_verbosity >= 2 emits every rejected candidate as its own
+    event with a reason (constraint / invalid / annealing), matching the
+    reference's per-mutation tmp_recorder detail
+    (src/RegularizedEvolution.jl:47-75, src/Mutate.jl:270-355)."""
+    X, y = _problem()
+    options = Options(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=16,
+        ncycles_per_iteration=30,
+        tournament_selection_n=4,
+        optimizer_probability=0.0,
+        output_directory=str(tmp_path),
+        use_recorder=True,
+        recorder_file="rec.json",
+        recorder_verbosity=2,
+    )
+    equation_search(
+        X, y, options=options, niterations=1, verbosity=0, run_id="evrun2",
+        seed=3,
+    )
+    with open(os.path.join(str(tmp_path), "evrun2", "rec.json")) as f:
+        rec = json.load(f)
+    ev_block = rec["iterations"][0]["events"][0]
+    rej = ev_block["rejected"]
+    assert len(rej) > 0
+    from symbolicregression_jl_tpu.core.options import MUTATION_KINDS
+
+    names = set(MUTATION_KINDS) | {"crossover"}
+    reasons = {"constraint", "invalid", "annealing", "none"}
+    for e in rej:
+        assert e["type"] in names
+        assert e["reason"] in reasons
+        assert isinstance(e["parent"], int)
+    # the aggregate counts agree with the per-event stream
+    assert sum(ev_block["rejected_counts"].values()) == len(rej)
+    # same seed, same search: verbosity only changes the log detail
+    accs = ev_block["accepted"]
+    assert len(accs) > 10
 
 
 def test_progress_bar_smoke(tmp_path, capsys):
