@@ -1,0 +1,111 @@
+"""Closed-form per-iteration ICI byte accounting for island sharding.
+
+Islands are data-independent for the whole evolve+optimize+finalize
+body (it runs island-local inside shard_map, engine._island_epilogue /
+generation_step); cross-device traffic exists ONLY in the epilogue's
+global phases (engine._epilogue_part):
+
+1. migration pool all_gather — each island contributes its topn best
+   member rows; the pool [I*topn rows] is consumed by every shard
+   (src/Migration.jl:15-37 analogue; the reshape under GSPMD lowers to
+   an all-gather over the island axis).
+2. hall-of-fame merge — update_hof reduces per-complexity argmin over
+   the member axis; XLA partitions this as per-shard partial HoFs +
+   a cross-shard combine. Upper bound used here: an all-gather of the
+   full flattened population (the partitioner never moves more than
+   that; the partitioned reduction moves ~maxsize rows * log2 D).
+3. hof-migration pool — the merged global HoF (maxsize rows) broadcast.
+4. running-stats histogram psum — maxsize f32.
+
+Everything else (cycles, fold, constant optimizer, finalize evals) is
+island-local: ZERO ICI bytes by construction.
+
+All quantities are computable from the config; this script prints the
+per-iteration byte volumes, the time at an assumed ICI bandwidth, and
+the communication-bound weak-scaling efficiency for a v5e-8.
+
+Usage: python profiling/ici_model.py [--islands 512] [--pop 256] ...
+(pure host arithmetic: no jax, no device).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def member_row_bytes(L: int, n_params: int = 0, n_classes: int = 0) -> int:
+    """One PopulationState member row: TreeBatch fields + metadata."""
+    tree = 3 * 4 * L + 4 * L + 4        # arity/op/feat i32, const f32, length
+    meta = 6 * 4                        # cost loss complexity birth ref parent
+    params = 4 * n_params * max(n_classes, 1 if n_params else 0)
+    return tree + meta + params
+
+
+def model(I, P, L, topn, maxsize, n_devices, iter_seconds,
+          ici_gbps, n_params=0, n_classes=0):
+    row = member_row_bytes(L, n_params, n_classes)
+    D = n_devices
+    ag_factor = (D - 1) / D  # per-device bytes moved by an all-gather
+
+    pool_bytes = I * topn * row * ag_factor
+    hof_upper = I * P * row * ag_factor          # partitioner worst case
+    hof_typical = maxsize * row * max(D - 1, 0)  # partial-HoF combine
+    hof_bcast = maxsize * row * ag_factor
+    stats = 2 * maxsize * 4
+
+    total_upper = pool_bytes + hof_upper + hof_bcast + stats
+    total_typical = pool_bytes + hof_typical + hof_bcast + stats
+    bw = ici_gbps * 1e9 / 8  # bytes/s per device
+    t_upper = total_upper / bw
+    t_typical = total_typical / bw
+    return {
+        "member_row_bytes": row,
+        "migration_pool_MB": round(pool_bytes / 2**20, 3),
+        "hof_merge_MB_upper": round(hof_upper / 2**20, 3),
+        "hof_merge_MB_typical": round(hof_typical / 2**20, 4),
+        "hof_broadcast_MB": round(hof_bcast / 2**20, 4),
+        "total_MB_per_iter_upper": round(total_upper / 2**20, 3),
+        "total_MB_per_iter_typical": round(total_typical / 2**20, 3),
+        "ici_seconds_per_iter_upper": round(t_upper, 6),
+        "ici_seconds_per_iter_typical": round(t_typical, 6),
+        "iter_seconds": iter_seconds,
+        "comm_fraction_upper": round(t_upper / iter_seconds, 8),
+        "weak_scaling_comm_efficiency_lower_bound": round(
+            1.0 / (1.0 + t_upper / iter_seconds), 6),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--islands", type=int, default=512,
+                    help="islands PER DEVICE (weak scaling)")
+    ap.add_argument("--pop", type=int, default=256)
+    ap.add_argument("--maxsize", type=int, default=30)
+    ap.add_argument("--topn", type=int, default=12)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--iter-seconds", type=float, default=8.5,
+                    help="measured single-chip bench iteration")
+    ap.add_argument("--ici-gbps", type=float, default=400.0,
+                    help="effective per-device ICI bandwidth, Gbit/s "
+                         "(v5e: 4 links x 400 Gbps raw; 400 effective "
+                         "is deliberately conservative ~25%%)")
+    args = ap.parse_args()
+
+    # Weak scaling: the GLOBAL island count grows with devices; each
+    # device keeps --islands local islands, and the all-gathered pool
+    # grows with global I.
+    I_global = args.islands * args.devices
+    out = model(I_global, args.pop, args.maxsize, args.topn, args.maxsize,
+                args.devices, args.iter_seconds, args.ici_gbps)
+    out["config"] = {
+        "islands_per_device": args.islands, "global_islands": I_global,
+        "population_size": args.pop, "maxsize": args.maxsize,
+        "topn": args.topn, "devices": args.devices,
+        "ici_gbps_assumed": args.ici_gbps,
+    }
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -274,10 +274,13 @@ def suite(args):
     here = os.path.abspath(__file__)
     runs = []
     if getattr(args, "suite_si", False):
-        # SI-united Feynman tier: dimensional analysis active end-to-end
+        # SI-united Feynman tier: dimensional analysis active end-to-end.
+        # All three legs since round 5 (the round-4 verdict flagged the
+        # tpu31 leg as null here): the SI tier now also measures the
+        # config-sensitivity story with units active.
         for name in FEYNMAN_SI:
             for seed in range(args.seeds_feynman):
-                for leg in ("refproxy", "tpunative"):
+                for leg in LEGS:
                     runs.append((name, leg, seed, args.budget_feynman))
     else:
         for seed in range(args.seeds_bench):

@@ -30,9 +30,12 @@ def main():
     ap.add_argument("--ncycles", type=int, default=50)
     args = ap.parse_args()
 
+    import os
+
     import jax
 
     from symbolicregression_jl_tpu import search_key
+    from symbolicregression_jl_tpu.evolve.engine import Engine
     from symbolicregression_jl_tpu.parallel.mesh import (
         make_mesh,
         shard_device_data,
@@ -44,12 +47,16 @@ def main():
     results = []
     for n in counts:
         I = args.islands * n
-        options, ds, engine = make_bench_problem(
+        options, ds, _ = make_bench_problem(
             populations=I, population_size=args.population_size,
             tournament_selection_n=8,
             ncycles_per_iteration=args.ncycles,
         )
+        # Build the engine WITH the mesh so the island-sharded paths
+        # (shard_map turbo on TPU; GSPMD-partitioned jnp on CPU) engage.
         mesh = make_mesh(devices[:n], n_island_shards=n, n_data_shards=1)
+        engine = Engine(options, ds.nfeatures, n_island_shards=n,
+                        mesh=mesh)
         data = shard_device_data(ds.data, mesh)
         state = engine.init_state(search_key(0), data, I)
         state = shard_search_state(state, mesh)
@@ -65,12 +72,22 @@ def main():
         results.append({
             "devices": n, "islands": I, "evals_per_sec": round(rate, 1),
             "evals_per_sec_per_device": round(rate / n, 1),
+            "turbo": bool(engine.cfg.turbo),
         })
         print(json.dumps(results[-1]), flush=True)
 
-    print(json.dumps({"metric": "weak_scaling_islands_per_device",
-                      "islands_per_device": args.islands,
-                      "points": results}))
+    payload = {"metric": "weak_scaling_islands_per_device",
+               "islands_per_device": args.islands,
+               "population_size": args.population_size,
+               "ncycles": args.ncycles,
+               "backend": jax.default_backend(),
+               "points": results}
+    print(json.dumps(payload))
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       f"weak_scaling_{jax.default_backend()}.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    print("wrote", out)
 
 
 if __name__ == "__main__":

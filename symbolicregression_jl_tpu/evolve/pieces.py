@@ -91,10 +91,13 @@ def concat_pieces(
         # masked-sum path: it is never the slow fusion, and the matmul
         # route would need a NaN/inf clamp that changes
         # overflowed-constant bits (cf. step._onehot_rows_f).
-        ohf = oh.astype(s_const.dtype)
+        # Always f32 regardless of the tree's const/eval dtype: a
+        # bfloat16 matmul would round int values above 256 (e.g. feature
+        # indices on wide datasets) before the contraction.
+        ohf = oh.astype(jnp.float32)
         ints = jnp.stack([s_arity, s_op, s_feat], axis=1)        # [S, 3]
         iout = jnp.round(jnp.matmul(
-            ohf, ints.astype(s_const.dtype),
+            ohf, ints.astype(jnp.float32),
             precision=jax.lax.Precision.HIGHEST))                # [L, 3]
 
         def take_i(col, field):

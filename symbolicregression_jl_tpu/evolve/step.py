@@ -180,11 +180,13 @@ def evolve_config_from_options(options: Options, nfeatures: int,
         # cleanly over the data axis, with the final loss reduction
         # lowering to a psum over ICI.
         turbo = False
-    if n_island_shards > 1 and (template is not None or n_params > 0):
-        # The shard_map turbo path (engine._shard_islands) covers plain
-        # expressions; template/parametric searches under island sharding
-        # take the jnp interpreter, which GSPMD partitions cleanly.
-        turbo = False
+    # (Template and parametric searches keep turbo under island sharding
+    # since round 5: the shard_map treatment in engine._evolve_part /
+    # _island_epilogue is pytree-generic — pops.params shards with the
+    # population, the template structure is static config, and the fused
+    # template/parametric kernels launch per-device on local islands
+    # exactly like the plain-expression kernels. Covered by
+    # tests/test_sharded_turbo.py and __graft_entry__.dryrun_multichip.)
     return EvolveConfig(
         operators=options.operators,
         maxsize=options.maxsize,
@@ -1004,9 +1006,12 @@ def generation_step(
         parent2_1 = jnp.where(is_xover, pop.ref[i2], -1)
         parent_cost2 = jnp.stack([m1_cost, pop.cost[i2]], axis=1)
         # Rejection reasons (codes in the CycleEvents docstring).
+        # "invalid" covers any non-finite candidate cost: +inf losses
+        # (invalid evals map to inf, not NaN) would otherwise fall
+        # through to prob=0 and be mislabeled "annealing".
         mut_reason = jnp.where(
             ~mut_success, 1,
-            jnp.where(jnp.isnan(after_cost), 2,
+            jnp.where(~jnp.isfinite(after_cost), 2,
                       jnp.where(~anneal_ok, 3, 0))).astype(jnp.int32)
         xo_reason = jnp.where(
             ~xo_success, 1, jnp.where(xo_nan, 2, 0)).astype(jnp.int32)
