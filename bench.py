@@ -36,41 +36,37 @@ WARMUP_ITERS = 1
 MEASURE_ITERS = 3
 
 
-def _cpu_mesh_scaling_efficiency() -> "tuple[float, dict] | None":
-    """Measured weak-scaling efficiency at the largest virtual-CPU-mesh
-    point (profiling/weak_scaling_cpu.json, produced by
-    profiling/weak_scaling.py on the 8-device host mesh), as
-    rate_per_device(N) / rate_per_device(1).
+def _v5e8_comm_efficiency(iter_seconds: float) -> "tuple[float, dict]":
+    """Communication-bound weak-scaling efficiency for a v5e-8 from the
+    closed-form ICI byte model (profiling/ici_model.py).
 
-    The file's config is validated (a real sweep, not an exploratory
-    tiny run) and echoed in the bench record so the projection's
-    provenance is visible."""
-    import json as _json
+    Islands are data-independent — the per-chip program at 512 local
+    islands is EXACTLY the measured single-chip program; the only
+    cross-chip traffic is the migration-pool all-gather + HoF merge +
+    stats psum. A virtual CPU mesh cannot measure this (its 'devices'
+    share the host cores, so per-device throughput mechanically drops
+    ~1/n); profiling/weak_scaling.py exists to (a) produce the real
+    number the day multi-chip hardware is attached and (b) validate
+    that the sharded program executes at 1..8 shards, which the driver's
+    dryrun_multichip also pins every round."""
     import os as _os
+    import sys as _sys
 
-    path = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
-                         "profiling", "weak_scaling_cpu.json")
-    if not _os.path.exists(path):
-        return None
-    with open(path) as f:
-        payload = _json.load(f)
-    pts = payload.get("points", [])
-    cfg = {
-        "islands_per_device": payload.get("islands_per_device"),
-        "population_size": payload.get("population_size"),
-        "ncycles": payload.get("ncycles"),
-        "max_devices": max((p["devices"] for p in pts), default=0),
+    _sys.path.insert(0, _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), "profiling"))
+    from ici_model import model
+
+    # Worst-case partitioner bound at the bench config, conservative
+    # 400 Gbit/s effective ICI (v5e raw per-chip is ~4x that);
+    # iter_seconds is THIS run's measured per-iteration wall time.
+    m = model(I=512 * 8, P=256, L=30, topn=12, maxsize=30, n_devices=8,
+              iter_seconds=iter_seconds, ici_gbps=400.0)
+    return m["weak_scaling_comm_efficiency_lower_bound"], {
+        "model": "profiling/ici_model.py worst-case partitioner bound",
+        "total_MB_per_iter_upper": m["total_MB_per_iter_upper"],
+        "measured_iter_seconds": round(iter_seconds, 2),
+        "ici_gbps_assumed": 400.0,
     }
-    # Guard against projecting from a noise-dominated exploratory run.
-    if (len(pts) < 2 or cfg["max_devices"] < 8
-            or (cfg["islands_per_device"] or 0) < 32
-            or (cfg["population_size"] or 0) < 64):
-        return None
-    base = pts[0]["evals_per_sec_per_device"]
-    last = max(pts, key=lambda p: p["devices"])
-    if not base:
-        return None
-    return last["evals_per_sec_per_device"] / base, cfg
 
 
 def main() -> None:
@@ -153,19 +149,18 @@ def main() -> None:
     }
     if n_dev == 1:
         # Projected v5e-8: measured single-chip rate x 8 devices x the
-        # MEASURED virtual-CPU-mesh weak-scaling efficiency (islands are
-        # data-independent; the only ICI traffic is the migration pool
-        # all-gather + HoF merge, < 0.2% of iteration time even at the
-        # partitioner's worst-case bound — profiling/ici_model.py).
-        scaling = _cpu_mesh_scaling_efficiency()
-        if scaling is not None:
-            eff, scfg = scaling
-            proj = rate * 8 * min(eff, 1.0)
-            rec["projected_v5e8"] = round(proj, 1)
-            rec["projected_v5e8_vs_baseline"] = round(
-                proj / MEASURED_CPU_EVALS_PER_SEC, 2)
-            rec["projection_scaling_efficiency"] = round(min(eff, 1.0), 4)
-            rec["projection_scaling_source"] = scfg
+        # communication-bound efficiency from the closed-form ICI model
+        # (the per-chip program at 512 local islands IS the measured
+        # single-chip program; migration/HoF collectives are the only
+        # cross-chip traffic, < 0.2% of iteration time at the
+        # partitioner's worst-case bound).
+        eff, src = _v5e8_comm_efficiency(elapsed / MEASURE_ITERS)
+        proj = rate * 8 * min(eff, 1.0)
+        rec["projected_v5e8"] = round(proj, 1)
+        rec["projected_v5e8_vs_baseline"] = round(
+            proj / MEASURED_CPU_EVALS_PER_SEC, 2)
+        rec["projection_comm_efficiency"] = round(min(eff, 1.0), 4)
+        rec["projection_source"] = src
     print(json.dumps(rec))
 
 

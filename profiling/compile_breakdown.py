@@ -1,0 +1,83 @@
+"""Cold-start compile breakdown for a device-scale quickstart fit.
+
+Runs `SRRegressor(device_scale="auto").fit()` in a FRESH process with
+the persistent compile cache disabled and `jax_log_compiles` on, then
+aggregates the logged per-module compile times — showing where the
+cold-start minutes go (evolve chunk programs, epilogue, init, eval
+paths) and what the floor is.
+
+Usage:
+  python profiling/compile_breakdown.py          # orchestrates the child
+  python profiling/compile_breakdown.py --child  # the measured fit
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+
+def child():
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)
+    import jax
+
+    jax.config.update("jax_log_compiles", True)
+    logging.getLogger("jax._src.interpreters.pxla").setLevel(logging.DEBUG)
+    logging.getLogger("jax._src.dispatch").setLevel(logging.DEBUG)
+
+    import numpy as np
+
+    import symbolicregression_jl_tpu as sr
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-3, 3, (500, 2)).astype(np.float32)
+    y = (2.0 * np.cos(23.5 * X[:, 0]) - X[:, 1] ** 2).astype(np.float32)
+    t0 = time.perf_counter()
+    model = sr.SRRegressor(niterations=2, binary_operators=["+", "-", "*"],
+                           unary_operators=["cos"])
+    model.fit(X, y)
+    print(f"TOTAL_FIT_SECONDS {time.perf_counter() - t0:.1f}", flush=True)
+
+
+def main():
+    if "--child" in sys.argv:
+        child()
+        return
+    env = dict(os.environ)
+    env["SR_NO_COMPILE_CACHE"] = "1"   # cold start
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        capture_output=True, text=True, env=env, timeout=3600)
+    wall = time.time() - t0
+    txt = proc.stderr + proc.stdout
+    # jax_log_compiles lines: "Finished XLA compilation of <name> in <t> sec"
+    pat = re.compile(
+        r"Finished (?:tracing \+ transforming|XLA compilation) of ([^\n]*?) "
+        r"in ([0-9.]+) sec")
+    agg = {}
+    for m in pat.finditer(txt):
+        name, secs = m.group(1), float(m.group(2))
+        key = name.strip()[:60]
+        agg[key] = agg.get(key, 0.0) + secs
+    total_line = next((l for l in txt.splitlines()
+                       if l.startswith("TOTAL_FIT_SECONDS")), "?")
+    print(f"cold quickstart subprocess wall: {wall:.1f}s   {total_line}")
+    print("compile-time aggregation (top 20):")
+    for k, v in sorted(agg.items(), key=lambda kv: -kv[1])[:20]:
+        print(f"  {v:8.1f} s  {k}")
+    print(f"  {sum(agg.values()):8.1f} s  TOTAL logged compile")
+    if proc.returncode != 0:
+        print("CHILD FAILED:\n", proc.stderr[-2000:])
+
+
+if __name__ == "__main__":
+    main()

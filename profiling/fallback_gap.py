@@ -1,0 +1,69 @@
+"""Measured cost of the jnp-interpreter fallback vs the fused path.
+
+Row-sharded datasets (n_data_shards > 1) drop turbo: `pl.pallas_call`
+has no GSPMD partitioning rule, and the jnp interpreter partitions
+cleanly with the loss reduction lowering to a psum over the data axis
+(evolve/step.py evolve_config_from_options). This harness quantifies
+what that fallback costs at bench scale on ONE chip: the same config
+with turbo forced off vs on — the per-device work of a row-sharded
+N-chip run is exactly the turbo-off leg on 1/N of the rows, so the
+single-chip gap bounds the per-device gap.
+
+Usage: python profiling/fallback_gap.py [islands] [pop] [ncycles]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from _common import make_bench_problem
+
+
+def time_one(turbo, I, P, NC, iters=2):
+    from symbolicregression_jl_tpu import search_key
+
+    options, ds, engine = make_bench_problem(
+        populations=I, population_size=P, ncycles_per_iteration=NC,
+        tournament_selection_n=16, turbo=turbo)
+    state = engine.init_state(search_key(0), ds.data, I)
+    state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    e0 = float(state.num_evals)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    dt = (time.perf_counter() - t0) / iters
+    ev = (float(state.num_evals) - e0) / iters
+    return ev / dt
+
+
+def main():
+    I = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    P = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    NC = int(sys.argv[3]) if len(sys.argv) > 3 else 100
+
+    r_turbo = time_one(True, I, P, NC)
+    r_jnp = time_one(False, I, P, NC)
+    out = {
+        "metric": "turbo_vs_jnp_fallback_evals_per_sec",
+        "config": {"islands": I, "population_size": P, "ncycles": NC},
+        "turbo": round(r_turbo, 1),
+        "jnp_fallback": round(r_jnp, 1),
+        "gap_x": round(r_turbo / r_jnp, 2),
+    }
+    print(json.dumps(out))
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "fallback_gap.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -177,7 +177,13 @@ def single_run(problem: str, leg: str, seed: int, budget_s: float):
             maxsize=30, populations=31, population_size=27,
             ncycles_per_iteration=380, save_to_file=False, **ops,
         )
-        chunks = [95] * 4
+        # Single-launch iterations: at 31x27 an iteration is ~0.5-0.8 s,
+        # so per-iteration stop granularity already respects a 45-75 s
+        # budget — while each mid-iteration chunk poll costs a ~0.1 s
+        # blocking tunnel round trip (4 polls/iteration measured ~0.3
+        # s/iter, dragging the leg from ~95k to ~62k evals/s in round
+        # 5's first bench pass).
+        chunks = None
     ds = make_dataset(X, y, X_units=x_units, y_units=y_unit)
     ds.update_baseline_loss(options.elementwise_loss)
     engine = Engine(options, ds.nfeatures)
@@ -206,10 +212,13 @@ def single_run(problem: str, leg: str, seed: int, budget_s: float):
         return elapsed() < budget_s
 
     while True:
-        # Chunked execution with a budget check between chunks: a wall
-        # budget can stop mid-iteration (verdict weak #5 — iterations
-        # must not overrun the budget by multiples).
-        stop = (None if eval_budget is not None
+        # tpunative runs chunked with a budget check between chunks: a
+        # wall budget can stop its ~10 s iterations mid-flight (verdict
+        # weak #5). The 31x27 legs run single-launch (chunks=None, the
+        # stop callback is not consulted) — their sub-second iterations
+        # make per-iteration granularity sufficient, see the chunks
+        # comment above.
+        stop = (None if (eval_budget is not None or chunks is None)
                 else (lambda pending: elapsed() >= budget_s))
         state = engine.run_iteration(state, ds.data, options.maxsize,
                                      chunk_sizes=chunks, should_stop=stop)
@@ -286,27 +295,50 @@ def suite(args):
         for seed in range(args.seeds_bench):
             for leg in LEGS:
                 runs.append(("bench", leg, seed, args.budget_bench))
+        # The unitless Feynman tier carries the native-vs-proxy claim;
+        # its tpu31 legs are optional (--legs-feynman 2 drops them —
+        # the matched-config story is carried by the bench problem and
+        # the SI tier, where the verdict asks for it explicitly).
+        fey_legs = (LEGS if getattr(args, "legs_feynman", 3) >= 3
+                    else ("refproxy", "tpunative"))
         for name in FEYNMAN:
             for seed in range(args.seeds_feynman):
-                for leg in LEGS:
+                for leg in fey_legs:
                     runs.append((name, leg, seed, args.budget_feynman))
 
+    out_path = os.path.join(
+        os.path.dirname(here),
+        "quality_si_results.json" if getattr(args, "suite_si", False)
+        else "quality_results.json")
+
+    def save(results):
+        with open(out_path, "w") as f:
+            json.dump({"runs": results, "summary": summarize(results),
+                       "config": vars(args), "ref_rate": REF_RATE},
+                      f, indent=1)
+
     results = []
+    done = set()
+    if getattr(args, "resume", False) and os.path.exists(out_path):
+        with open(out_path) as f:
+            prior = json.load(f).get("runs", [])
+        results = [r for r in prior if "best_loss" in r]
+        # budget is part of the identity: resuming with a different
+        # budget must re-run, not silently pool mixed-budget records.
+        done = {(r["problem"], r["leg"], r["seed"], r.get("budget_s"))
+                for r in results}
+        print(f"resuming: {len(results)} prior runs kept", flush=True)
+    runs = [r for r in runs if (r[0], r[1], r[2], r[3]) not in done]
     for problem, leg, seed, budget in runs:
         rec = _run_one(problem, leg, seed, budget)
         results.append(rec)
         print(f"{problem:10s} {leg:9s} seed={seed}: "
               f"best={rec.get('best_loss', 'ERR')} "
               f"(real {rec.get('real_wall_s', '?')}s)", flush=True)
-        # incremental save so a crash keeps partial results
-        out_path = os.path.join(
-            os.path.dirname(here),
-            "quality_si_results.json" if getattr(args, "suite_si", False)
-            else "quality_results.json")
-        with open(out_path, "w") as f:
-            json.dump({"runs": results, "summary": summarize(results),
-                       "config": vars(args), "ref_rate": REF_RATE},
-                      f, indent=1)
+        save(results)  # incremental: a crash keeps partial results
+    # Always rewrite at the end: a resume with nothing left still
+    # re-applies the current summarize() to the stored runs.
+    save(results)
     print("wrote", out_path)
     _print_summary(summarize(results))
 
@@ -323,8 +355,21 @@ def _time_to(curve, target):
     return None
 
 
+def _best_env(r):
+    """Best-so-far envelope: the minimum loss the search EVER held —
+    what the user-facing hall of fame retains (update_hof runs every
+    cycle) — rather than the final population's min, which can regress
+    past the budget point with adaptive parsimony (the round-5 bench
+    pass showed identical-trajectory legs differing only by where the
+    clock stopped mid-oscillation)."""
+    if r.get("curve"):
+        return min(b for _, b in r["curve"])
+    return r["best_loss"]
+
+
 def summarize(results):
-    """Per problem: median final loss per leg + wall-to-target ratios.
+    """Per problem: median best-so-far loss per leg + wall-to-target
+    ratios.
 
     ``speedup_vs_ref``: per seed, proxy virtual budget / tpunative real
     time-to-(proxy's final loss); >1 means the TPU-native config reaches
@@ -340,10 +385,14 @@ def summarize(results):
                 and "best_loss" in r]
         med = {}
         for leg in LEGS:
-            ls = sorted(r["best_loss"] for r in rows if r["leg"] == leg)
+            ls = sorted(_best_env(r) for r in rows if r["leg"] == leg)
             med[leg] = ls[len(ls) // 2] if ls else None
+        def nw(a, b):
+            return (a < SOLVED and b < SOLVED) or a <= b * 1.05
+
         per_seed = []
         not_worse = 0
+        t31_nw = t31_n = 0
         seeds = sorted({r["seed"] for r in rows})
         for sd in seeds:
             proxy = next((r for r in rows
@@ -351,27 +400,55 @@ def summarize(results):
             native = next((r for r in rows
                            if r["leg"] == "tpunative" and r["seed"] == sd),
                           None)
-            if proxy is None or native is None:
+            t31 = next((r for r in rows
+                        if r["leg"] == "tpu31" and r["seed"] == sd), None)
+            if proxy is None:
                 continue
-            t_n = native["best_loss"]
-            t_p = proxy["best_loss"]
-            if (t_n < SOLVED and t_p < SOLVED) or t_n <= t_p * 1.05:
-                not_worse += 1
+            t_p = _best_env(proxy)
+            if t31 is not None:
+                # Matched-config leg: tpu31 (same algorithm + config,
+                # REAL wall-clock) vs the rate-matched proxy.
+                t31_n += 1
+                t31_nw += nw(_best_env(t31), t_p)
+            if native is None:
+                continue
+            t_n = _best_env(native)
+            not_worse += nw(t_n, t_p)
             tt = _time_to(native["curve"], t_p)
-            # proxy "spent" its full virtual budget reaching t_p
-            proxy_time = proxy["curve"][-1][0] if proxy["curve"] else None
+            # Symmetric accounting: the proxy is charged its OWN virtual
+            # time to first reach its best-so-far (not the full budget —
+            # with the envelope metric it may hit its best early).
+            proxy_time = _time_to(proxy["curve"], t_p)
+            # Granularity flag: when the native leg already meets the
+            # target at its FIRST recorded point, its true
+            # time-to-target is only upper-bounded by one full
+            # device-scale iteration (~10 s) — the speedup is then a
+            # LOWER bound quantized by the iteration, not a measurement
+            # (trivially-solved problems land here; the tpu31 leg
+            # carries the latency story for those).
+            first_pt = (native["curve"][0] if native.get("curve") else None)
+            quantized = bool(
+                first_pt is not None and tt is not None
+                and tt <= first_pt[0])
             per_seed.append({
                 "seed": sd, "proxy_final": t_p, "native_final": t_n,
                 "native_time_to_proxy_final": tt,
+                "proxy_time_to_own_best": proxy_time,
+                "native_first_point_quantized": quantized,
                 "speedup_vs_ref": (round(proxy_time / tt, 2)
                                    if (tt and proxy_time) else None),
             })
         sp = sorted(s["speedup_vs_ref"] for s in per_seed
                     if s["speedup_vs_ref"] is not None)
+        n_quant = sum(1 for s in per_seed
+                      if s["native_first_point_quantized"])
         summary[problem] = {
             "median_best": med,
             "native_not_worse_than_proxy": f"{not_worse}/{len(seeds)}",
+            "tpu31_not_worse_than_proxy": (
+                f"{t31_nw}/{t31_n}" if t31_n else None),
             "median_speedup_vs_ref": sp[len(sp) // 2] if sp else None,
+            "speedup_quantized_seeds": f"{n_quant}/{len(per_seed)}",
             "per_seed": per_seed,
         }
     return summary
@@ -383,7 +460,9 @@ def _print_summary(summary):
         print(f"  {k:10s} proxy={m.get('refproxy')} "
               f"tpu31={m.get('tpu31')} native={m.get('tpunative')} "
               f"not_worse={v['native_not_worse_than_proxy']} "
-              f"speedup={v['median_speedup_vs_ref']}")
+              f"tpu31_nw={v.get('tpu31_not_worse_than_proxy')} "
+              f"speedup={v['median_speedup_vs_ref']} "
+              f"(quantized {v.get('speedup_quantized_seeds')})")
 
 
 def repair(args):
@@ -421,6 +500,12 @@ def main():
     ap.add_argument("--budget-feynman", type=float, default=45.0)
     ap.add_argument("--seeds-bench", type=int, default=3)
     ap.add_argument("--seeds-feynman", type=int, default=2)
+    ap.add_argument("--legs-feynman", type=int, default=3,
+                    help="3 = all legs; 2 = drop tpu31 from the unitless "
+                         "Feynman tier (kept in bench + SI)")
+    ap.add_argument("--resume", action="store_true",
+                    help="keep completed runs from the existing results "
+                         "file; run only missing (problem, leg, seed)")
     args = ap.parse_args()
     if args.run:
         problem, leg, seed, budget = args.run
