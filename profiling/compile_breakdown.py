@@ -30,6 +30,8 @@ def child():
     import jax
 
     jax.config.update("jax_log_compiles", True)
+    # SR_XLA_EFFORT is honored by equation_search itself
+    # (_apply_compile_effort) before anything compiles.
     logging.getLogger("jax._src.interpreters.pxla").setLevel(logging.DEBUG)
     logging.getLogger("jax._src.dispatch").setLevel(logging.DEBUG)
 

@@ -53,7 +53,8 @@ __all__ = [
 
 def __getattr__(name):
     # Lazily expose the heavier API surface to keep import light.
-    if name in ("equation_search", "SearchState", "RuntimeOptions"):
+    if name in ("equation_search", "SearchState", "RuntimeOptions",
+                "warmup"):
         from .api import search
 
         return getattr(search, name)

@@ -373,6 +373,35 @@ def _enable_default_compile_cache() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 
+def _apply_compile_effort() -> None:
+    """Honor ``SR_XLA_EFFORT`` (e.g. ``-1.0``): forwards to JAX's
+    ``jax_exec_time_optimization_effort``, trading XLA optimization
+    effort for compile time. Measured at the device-scale quickstart
+    (profiling/compile_breakdown.py): effort -1.0 cuts the cold-start
+    compile from ~220 s to ~164 s (evolve program 138→47 s, init
+    48→8.5 s; the epilogue's Pallas/Mosaic kernels are unaffected) —
+    but costs ~3× steady-state throughput (bench 507k → 165k evals/s;
+    -0.5 measures the same), so it is ONLY for compile-bound contexts
+    like CI smoke runs, never production fits. Process-global, like
+    the persistent-cache setup above; left at JAX's default unless the
+    env var is set.
+    """
+    eff = os.environ.get("SR_XLA_EFFORT")
+    if not eff:
+        return
+    if jax.config.jax_exec_time_optimization_effort != 0.0:
+        return  # user already configured it programmatically
+    try:
+        value = float(eff)
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"SR_XLA_EFFORT={eff!r} is not a float; ignoring it")
+        return
+    jax.config.update("jax_exec_time_optimization_effort", value)
+
+
 def equation_search(
     X,
     y=None,
@@ -413,6 +442,7 @@ def equation_search(
     """
     options = options or Options()
     _enable_default_compile_cache()
+    _apply_compile_effort()
     # Copy so the caller's RuntimeOptions is never mutated (it may be
     # reused across searches).
     ropt = (
@@ -878,6 +908,56 @@ def equation_search(
         )
         return host_state, result
     return result
+
+
+def warmup(
+    options: Optional[Options] = None,
+    *,
+    nfeatures: int = 2,
+    n_rows: int = 10_000,
+    niterations: int = 4,
+    dtype=None,
+    seed: int = 0,
+) -> None:
+    """Pre-compile the search programs for a config, warming the
+    persistent XLA cache so the first real ``fit`` at the same shapes
+    starts in seconds instead of minutes.
+
+    XLA compiles are keyed on program *shapes*: islands × population
+    (``options.populations`` / ``population_size``), ``maxsize``, the
+    operator set, ``nfeatures``, dataset rows, and batch size. Call
+    this with the same ``Options`` and data shape you will fit with —
+    e.g. once on a build machine, or at service start-up — and the
+    cold-start compile (~2.5 min at the device-scale config,
+    profiling/compile_breakdown.py) is paid here instead of in the
+    user-facing fit. Nothing is written to disk (saving is disabled on
+    a copy of ``options``); the random fitting data never matters —
+    only shapes do.
+
+    Chunk-count adaptation picks evolve-chunk lengths from measured
+    iteration time (quantized powers of two over divisor-stable
+    sizes), so the default 4 iterations let warmup adapt the same way
+    a real fit on this machine would and pre-compile the adapted
+    chunk program too, not just the initial one.
+
+    ``SR_XLA_EFFORT=-1`` cuts the one-time compile a further ~25%
+    but costs ~3× steady-state device throughput (measured, both
+    -0.5 and -1.0: bench 507k → 165-169k evals/s) — only worth it
+    for compile-only contexts (CI smoke runs), never for real fits,
+    and note the persistent cache keys on compile options, so a
+    warmup at one effort level does not warm fits at another.
+    """
+    import copy
+
+    options = copy.copy(options) if options is not None else Options()
+    options.save_to_file = False
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-3.0, 3.0, (int(n_rows), int(nfeatures)))
+    y = rng.uniform(-1.0, 1.0, (int(n_rows),))
+    equation_search(
+        X, y, options=options, niterations=niterations,
+        verbosity=0, progress=False, seed=seed, dtype=dtype,
+    )
 
 
 def _is_guess_pair(g) -> bool:

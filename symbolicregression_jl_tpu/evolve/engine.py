@@ -148,8 +148,8 @@ class Engine:
         self._init_state = jax.jit(self._init_state_impl, static_argnums=(2,))
         # (cost, loss, complexity) for a flat batch of host-encoded trees —
         # the guess-seeding / warm-start re-eval path.
-        self._eval_cost = jax.jit(
-            lambda trees, data, member_params=None: eval_cost_batch(
+        def eval_cost_flat(trees, data, member_params=None):
+            return eval_cost_batch(
                 trees, data, self.options.elementwise_loss, self.tables,
                 self.cfg.operators, self.cfg.parsimony,
                 member_params=member_params,
@@ -159,7 +159,8 @@ class Engine:
                 wildcard_constants=self.cfg.wildcard_constants,
                 template=self.cfg.template,
             )
-        )
+
+        self._eval_cost = jax.jit(eval_cost_flat)
 
     @property
     def n_params(self) -> int:
@@ -271,32 +272,17 @@ class Engine:
             f"chunk_sizes {chunk_sizes} must sum to {self.cfg.ncycles}"
         )
         cfg = self.cfg
-        cur_maxsize = jnp.int32(cur_maxsize)
         # Same key derivation as the single-launch path (bit-identical).
-        key, k_batch, k_cycle, k_opt, k_mig = jax.random.split(state.key, 5)
-        batch_idx = None
-        if cfg.batching:
-            batch_idx = jax.random.randint(
-                k_batch, (cfg.batch_size,), 0, data.y.shape[0]
-            )
+        # One jitted prelude instead of ~20 eager op dispatches: on the
+        # tunneled TPU backend each distinct eager op costs ~1 s of
+        # one-time compile (the HoF-pytree broadcast_to alone logged
+        # 19 s in profiling/compile_breakdown.py), so the first
+        # iteration of a quickstart paid ~25 s here.
+        cur_maxsize, key, k_cycle, k_opt, k_mig, batch_idx, carry = (
+            self._prelude_fn(state.key, jnp.int32(cur_maxsize),
+                             data.y.shape[0], state.birth.shape[0],
+                             state.pops.cost.dtype))
         pops, birth, ref = state.pops, state.birth, state.ref
-        # One evolve program serves every chunk: the first chunk gets an
-        # explicit empty carry (the same values s_r_cycle would build
-        # internally) instead of compiling a second carry-less program
-        # variant — at the device-scale config each evolve-program
-        # compile costs tens of seconds, dominating quickstart fits.
-        I = birth.shape[0]
-        P = cfg.population_size
-        hof0 = empty_hof(cfg.maxsize, cfg.max_nodes, pops.cost.dtype,
-                         cfg.n_params, cfg.n_classes,
-                         template_k=(cfg.template.n_subexpressions
-                                     if cfg.template else 0))
-        carry = (
-            jax.tree.map(lambda x: jnp.broadcast_to(x, (I,) + x.shape),
-                         hof0),
-            jnp.zeros((I,), jnp.float32),
-            (jnp.zeros((I, P), jnp.bool_), jnp.zeros((I, P), jnp.bool_)),
-        )
         c0 = 0
         ev_chunks = []
         for i, nc in enumerate(chunk_sizes):
@@ -337,6 +323,45 @@ class Engine:
             return new_state, events
         return new_state
 
+    @property
+    def _prelude_fn(self):
+        """Jitted chunked-iteration prelude: key split, minibatch draw,
+        and the first chunk's explicit empty carry (the same values
+        s_r_cycle would build internally — one evolve program then
+        serves every chunk instead of compiling a second carry-less
+        variant, which costs tens of seconds at device scale)."""
+        if not hasattr(self, "_prelude_jit"):
+            cfg = self.cfg
+            P = cfg.population_size
+
+            def iteration_prelude(key, cur_maxsize, nrows, I, cost_dtype):
+                key, k_batch, k_cycle, k_opt, k_mig = jax.random.split(key, 5)
+                batch_idx = None
+                if cfg.batching:
+                    batch_idx = jax.random.randint(
+                        k_batch, (cfg.batch_size,), 0, nrows)
+                # cost_dtype (not self.dtype): must match the carry-less
+                # path's pops.cost.dtype so every chunk shares one
+                # compiled program and chunked == single-launch.
+                hof0 = empty_hof(
+                    cfg.maxsize, cfg.max_nodes, cost_dtype,
+                    cfg.n_params, cfg.n_classes,
+                    template_k=(cfg.template.n_subexpressions
+                                if cfg.template else 0))
+                carry = (
+                    jax.tree.map(
+                        lambda x: jnp.broadcast_to(x, (I,) + x.shape), hof0),
+                    jnp.zeros((I,), jnp.float32),
+                    (jnp.zeros((I, P), jnp.bool_),
+                     jnp.zeros((I, P), jnp.bool_)),
+                )
+                return cur_maxsize, key, k_cycle, k_opt, k_mig, batch_idx, \
+                    carry
+
+            self._prelude_jit = jax.jit(iteration_prelude,
+                                        static_argnums=(2, 3, 4))
+        return self._prelude_jit
+
     def _chunk_fn(self, ncycles: int, batching: bool):
         """Jitted evolve-chunk for a given (static) chunk length."""
         if not hasattr(self, "_chunk_cache"):
@@ -344,21 +369,26 @@ class Engine:
         k = (ncycles, batching)
         if k not in self._chunk_cache:
             cfg = self.cfg._replace(ncycles=ncycles)
-            self._chunk_cache[k] = jax.jit(
-                lambda pops, birth, ref, stats_nf, data, cm, kc, bi, c0, carry:
-                self._evolve_part(pops, birth, ref, stats_nf, data, cm, kc,
-                                  bi, c0, carry, cfg)
-            )
+
+            def _chunk(pops, birth, ref, stats_nf, data, cm, kc, bi, c0,
+                       carry):
+                return self._evolve_part(pops, birth, ref, stats_nf, data,
+                                         cm, kc, bi, c0, carry, cfg)
+
+            # Named so jax_log_compiles / compile_breakdown.py attribute
+            # compile seconds to the evolve program per chunk length.
+            _chunk.__name__ = f"evolve_chunk_c{ncycles}"
+            self._chunk_cache[k] = jax.jit(_chunk)
         return self._chunk_cache[k]
 
     @property
     def _epilogue_fn(self):
         if not hasattr(self, "_epilogue_jit"):
-            self._epilogue_jit = jax.jit(
-                lambda state, data, cm, evolved, key, ko, km, bi:
-                self._epilogue_part(state, data, cm, evolved, key, ko, km,
-                                    bi, self.cfg)
-            )
+            def iteration_epilogue(state, data, cm, evolved, key, ko, km, bi):
+                return self._epilogue_part(state, data, cm, evolved, key, ko,
+                                           km, bi, self.cfg)
+
+            self._epilogue_jit = jax.jit(iteration_epilogue)
         return self._epilogue_jit
 
     def _evolve_part(self, pops, birth, ref, stats_nf, data, cur_maxsize,

@@ -210,6 +210,26 @@ def test_entry_compiles():
     assert bool(np.isfinite(np.asarray(out)).any())
 
 
+def test_warmup_precompiles_quietly(capsys, tmp_path, monkeypatch):
+    """sr.warmup runs a tiny silent search to populate the compile
+    cache for a (config, shape) pair; it must not print, return, or
+    write anything to the working directory."""
+    import symbolicregression_jl_tpu as sr
+
+    monkeypatch.chdir(tmp_path)
+    # save_to_file=True (the Options default) must be overridden on a
+    # copy inside warmup — a pre-compile must never write equations
+    # fit to random noise into outputs/.
+    opts = small_options(ncycles_per_iteration=4, save_to_file=True)
+    out = sr.warmup(opts, nfeatures=2, n_rows=64, niterations=1)
+    assert out is None
+    assert opts.save_to_file is True  # caller's Options untouched
+    captured = capsys.readouterr()
+    assert captured.out == ""
+    assert captured.err == ""
+    assert list(tmp_path.iterdir()) == []
+
+
 def test_multihost_helpers_single_host():
     """initialize_multihost is an idempotent no-op on a single host
     (the SPMD design needs no worker bring-up — SURVEY.md §5.8)."""
