@@ -1,0 +1,62 @@
+"""Multi-device search: shard the island axis over a TPU mesh.
+
+On real hardware nothing is required: when multiple devices are
+visible, the engine shards islands automatically and the fused Pallas
+path runs island-local inside shard_map (migration's pool all-gather
+is the only cross-chip traffic — profiling/ici_model.py bounds it at
+<0.2% of iteration time on a v5e-8). This example demonstrates the
+same program on a virtual 8-device CPU mesh, the standard way to
+validate sharding without chips.
+
+Run:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/multi_device.py
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(niterations: int = 3, seed: int = 0) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # virtual mesh demo
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.api.search import RuntimeOptions
+
+    print(f"devices: {jax.devices()}")
+
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2.0, 2.0, (256, 2)).astype(np.float32)
+    y = np.cos(2.0 * X[:, 0]) + 0.5 * X[:, 1]
+
+    options = sr.Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        maxsize=12,
+        populations=8,   # 1 island per virtual device
+        population_size=16,
+        ncycles_per_iteration=20,
+    )
+    hof = sr.equation_search(
+        X, y,
+        options=options,
+        niterations=niterations,
+        runtime_options=RuntimeOptions(
+            niterations=niterations, verbosity=0, seed=seed,
+            devices=jax.devices(),
+        ),
+    )
+    for e in hof.pareto_frontier()[-3:]:
+        print(f"  {e.complexity:3d}  {e.loss:10.4g}  {e.equation_string()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -235,6 +235,31 @@ def _seed_population(
     cfg = engine.cfg
     I = state.birth.shape[0]
     P = cfg.population_size
+    if encoded is None:
+        # Oversized seeds (an LLM proposer or hand-typed guess beyond
+        # maxsize) are skipped with a warning, mirroring the reference's
+        # random-fallback-with-warning for invalid seed populations
+        # (src/SymbolicRegression.jl:835-857) — a bad seed must not
+        # abort the search.
+        kept, kept_params = [], []
+        ps = list(params) if params is not None else None
+        for i, t in enumerate(list(trees)[: I * P]):
+            n = t.count_nodes()
+            if n > cfg.max_nodes:
+                import warnings
+
+                warnings.warn(
+                    f"seed expression has {n} nodes > max_nodes="
+                    f"{cfg.max_nodes} (maxsize); skipping it")
+                continue
+            kept.append(t)
+            if ps is not None:
+                kept_params.append(ps[i] if i < len(ps) else None)
+        if not kept:
+            return state
+        trees = kept
+        if params is not None:
+            params = kept_params
     enc = (
         encoded
         if encoded is not None
@@ -319,7 +344,10 @@ def _seed_population(
         targets = order[P - k :]
 
         def put(dst, src):
-            return dst.at[0, targets].set(src[:k])
+            # dst may be a host numpy array (resuming from a
+            # device_get'ed SearchState): jit entry points accept those
+            # transparently, but .at[] indexed update is jax-only.
+            return jnp.asarray(dst).at[0, targets].set(src[:k])
 
         pops = dataclasses.replace(
             pops,
@@ -351,6 +379,14 @@ def _enable_default_compile_cache() -> None:
     if os.environ.get("SR_NO_COMPILE_CACHE"):
         return
     if jax.config.jax_compilation_cache_dir is not None:
+        return
+    # CPU backends: compiles are fast and XLA:CPU's AOT cache entries
+    # are keyed loosely enough that a cache written under one host's
+    # machine-feature set loads (with loud cpu_aot_loader errors and a
+    # SIGILL risk) on another — observed with +prefer-no-gather
+    # pseudo-features. The cache exists for minute-scale TPU compiles;
+    # leave CPU runs uncached unless the user opts in themselves.
+    if jax.default_backend() == "cpu":
         return
     # Respect a user-tuned cache threshold: only overwrite the value if
     # it still sits at JAX's own default (1.0s).
@@ -434,8 +470,9 @@ def equation_search(
     ``return_state=True``.
 
     Process-global side effect: unless opted out (SR_NO_COMPILE_CACHE=1)
-    or already configured, the first call enables JAX's persistent
-    compilation cache for the whole process (``jax_compilation_cache_dir``
+    or already configured, the first call on a non-CPU backend enables
+    JAX's persistent compilation cache for the whole process
+    (``jax_compilation_cache_dir``
     under ``~/.cache``; ``jax_persistent_cache_min_compile_time_secs`` is
     raised to 2.0s only if still at JAX's default) — this also affects
     unrelated JAX code running in the same process.
@@ -939,6 +976,12 @@ def warmup(
     sizes), so the default 4 iterations let warmup adapt the same way
     a real fit on this machine would and pre-compile the adapted
     chunk program too, not just the initial one.
+
+    On CPU backends the default persistent cache is disabled (see
+    ``_enable_default_compile_cache``: XLA:CPU AOT cache entries can
+    SIGILL across machine-feature sets), so warmup there warms nothing
+    unless you set ``jax_compilation_cache_dir`` yourself — it exists
+    for the TPU cold-start, which is where the minutes are.
 
     ``SR_XLA_EFFORT=-1`` cuts the one-time compile a further ~25%
     but costs ~3× steady-state device throughput (measured, both

@@ -92,6 +92,42 @@ def test_search_return_state_and_warm_start(linear_problem):
     assert best2 <= best1 + 1e-6  # warm start can only improve the HoF
 
 
+def test_warm_start_with_guesses(linear_problem):
+    """Resuming from a host SearchState AND seeding guesses in the same
+    call must work: the saved state's population arrays are numpy
+    (device_get'ed), and guess seeding does indexed updates on them —
+    regression test for the .at[] on numpy crash (the fork's
+    LLM-in-the-loop propose->seed->refine pattern does exactly this
+    every round)."""
+    X, y = linear_problem
+    opts = small_options()
+    state, hof = equation_search(
+        X, y, options=opts, niterations=1, seed=3, verbosity=0,
+        return_state=True,
+    )
+    best1 = min(e.loss for e in hof.entries)
+    _, hof2 = equation_search(
+        X, y, options=opts, niterations=1, seed=4, verbosity=0,
+        saved_state=state, guesses=["2.0 * x1 + x2"], return_state=True,
+    )
+    best2 = min(e.loss for e in hof2.entries)
+    assert best2 <= best1 + 1e-6
+
+
+def test_oversized_guess_skipped_with_warning(linear_problem):
+    """A guess longer than maxsize must not abort the search — it is
+    skipped with a warning (reference precedent: invalid seed
+    populations fall back to random with a warning)."""
+    X, y = linear_problem
+    big = " + ".join(["x1 * x2"] * 8)  # far beyond maxsize=12
+    with pytest.warns(UserWarning, match="skipping"):
+        hof = equation_search(
+            X, y, options=small_options(), niterations=1, seed=6,
+            verbosity=0, guesses=[big, "2.0 * x1 + x2"],
+        )
+    assert len(hof.entries) > 0
+
+
 def test_warm_start_rejects_incompatible_options(linear_problem):
     X, y = linear_problem
     state, _ = equation_search(
