@@ -31,6 +31,7 @@ def main(niterations: int = 6, seed: int = 0) -> None:
         populations=6,
         population_size=25,
         ncycles_per_iteration=60,
+        save_to_file=False,
     )
 
     # Hand-built starting points — e.g. near-miss forms from theory.

@@ -45,6 +45,7 @@ def main(rounds: int = 3, niterations: int = 8, seed: int = 0) -> None:
         populations=8,
         population_size=25,
         ncycles_per_iteration=80,
+        save_to_file=False,
     )
 
     state = None

@@ -44,6 +44,7 @@ def main(niterations: int = 3, seed: int = 0) -> None:
         populations=8,   # 1 island per virtual device
         population_size=16,
         ncycles_per_iteration=20,
+        save_to_file=False,
     )
     hof = sr.equation_search(
         X, y,

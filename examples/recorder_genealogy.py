@@ -35,6 +35,7 @@ def main(niterations: int = 3, seed: int = 0) -> None:
         ncycles_per_iteration=30,
         use_recorder=True,
         recorder_file=rec_path,
+        save_to_file=False,
     )
     sr.equation_search(X, y, options=options, niterations=niterations,
                        seed=seed, verbosity=0)
