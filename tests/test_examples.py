@@ -1,0 +1,49 @@
+"""Smoke tests for examples/: each script's main() must run end-to-end
+at a minimal budget. Keeps the documented walkthroughs from rotting as
+the API evolves (each was also verified converging at its full budget
+when written — see the round-5 log)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        f"examples_{name}", os.path.join(EXAMPLES, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_quickstart_smoke(capsys):
+    _load("quickstart").main(niterations=1)
+    assert "best:" in capsys.readouterr().out
+
+
+def test_custom_initial_population_smoke(capsys):
+    _load("custom_initial_population").main(niterations=1)
+    assert capsys.readouterr().out.strip()
+
+
+def test_llm_in_the_loop_smoke(capsys):
+    _load("llm_in_the_loop").main(rounds=2, niterations=1)
+    assert "final best:" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_remaining_examples_smoke(capsys):
+    _load("recorder_genealogy").main(niterations=1)
+    _load("template_expression").main(niterations=1)
+    _load("parametric_expression").main(niterations=1)
+    _load("dimensional_analysis").main(niterations=1)
+    # multi_device rides the conftest's 8-device virtual CPU mesh.
+    _load("multi_device").main(niterations=1)
+    assert capsys.readouterr().out.strip()
