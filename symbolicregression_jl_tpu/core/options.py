@@ -326,6 +326,12 @@ class Options:
         verbosity: Optional[int] = None,
         print_precision: int = 5,
         progress: Optional[bool] = None,
+        # Run the graftlint runtime auditor (lint/runtime.py
+        # validate_programs) over every engine state: postfix-encoding
+        # invariants are re-checked after init and after each iteration's
+        # mutation/crossover/migration output. Debug tier — each check
+        # pulls the population tables to host.
+        debug_checks: bool = False,
         # 15. Export
         output_directory: Optional[str] = None,
         save_to_file: bool = True,
@@ -494,6 +500,7 @@ class Options:
         self.deterministic = bool(deterministic)
         self.seed = seed
         self.verbosity = verbosity
+        self.debug_checks = bool(debug_checks)
         self.print_precision = int(print_precision)
         self.progress = progress
         self.output_directory = output_directory

@@ -174,8 +174,34 @@ class Engine:
     def init_state(self, key, data: DeviceData, n_islands: int,
                    initial_trees: Optional[TreeBatch] = None,
                    initial_params: Optional[jax.Array] = None) -> SearchDeviceState:
-        return self._init_state(key, data, n_islands, initial_trees,
-                                initial_params)
+        state = self._init_state(key, data, n_islands, initial_trees,
+                                 initial_params)
+        if self.options.debug_checks:
+            self._audit_state(state, where="init_state")
+        return state
+
+    def _audit_state(self, state: SearchDeviceState, where: str) -> None:
+        """graftlint runtime audit (options.debug_checks): re-check the
+        postfix-encoding invariants on the device-resident population
+        after mutation/crossover/migration have rewritten it. Pulls the
+        tables to host — debug tier only."""
+        from ..lint.runtime import validate_programs
+
+        cfg = self.cfg
+        # Template members carry a per-slot subexpression axis whose
+        # feature counts vary by slot; skip the feat-range check there.
+        nfeat = None if cfg.template is not None else self.nfeatures
+        n_params = None if cfg.template is not None else cfg.n_params
+        validate_programs(
+            state.pops.trees, cfg.operators, nfeatures=nfeat,
+            n_params=n_params, where=f"engine {where}: population",
+        )
+        # HoF slots only exist where `exists`; empty slots hold the
+        # all-padding single-constant tree, which is itself valid.
+        validate_programs(
+            state.hof.trees, cfg.operators, nfeatures=nfeat,
+            n_params=n_params, where=f"engine {where}: hall of fame",
+        )
 
     def _init_state_impl(self, key, data: DeviceData, n_islands: int,
                          initial_trees: Optional[TreeBatch] = None,
@@ -265,9 +291,21 @@ class Engine:
         single-launch iterations are otherwise bit-identical: the
         annealing ramp and per-cycle RNG fold-ins use global cycle
         indices.
+
+        ``cur_maxsize`` may be a host int or an already-uploaded device
+        scalar: a host int costs one (tiny) host→device transfer per
+        call, so hot loops that pin a transfer budget (graftlint's
+        ``no_transfer`` guard) pass ``jnp.int32(cur_maxsize)`` uploaded
+        once outside the loop — it only changes during maxsize warmup.
         """
+        if not isinstance(cur_maxsize, jax.Array):
+            cur_maxsize = jnp.int32(cur_maxsize)
         if not chunk_sizes or list(chunk_sizes) == [self.cfg.ncycles]:
-            return self._iteration(state, data, jnp.int32(cur_maxsize))
+            out = self._iteration(state, data, cur_maxsize)
+            if self.options.debug_checks:
+                new_state = out[0] if self.cfg.record_events else out
+                self._audit_state(new_state, where="run_iteration")
+            return out
         assert sum(chunk_sizes) == self.cfg.ncycles, (
             f"chunk_sizes {chunk_sizes} must sum to {self.cfg.ncycles}"
         )
@@ -279,7 +317,7 @@ class Engine:
         # 19 s in profiling/compile_breakdown.py), so the first
         # iteration of a quickstart paid ~25 s here.
         cur_maxsize, key, k_cycle, k_opt, k_mig, batch_idx, carry = (
-            self._prelude_fn(state.key, jnp.int32(cur_maxsize),
+            self._prelude_fn(state.key, cur_maxsize,
                              data.y.shape[0], state.birth.shape[0],
                              state.pops.cost.dtype))
         pops, birth, ref = state.pops, state.birth, state.ref
@@ -317,6 +355,8 @@ class Engine:
         new_state = self._epilogue_fn(
             state, data, cur_maxsize, evolved, key, k_opt, k_mig, batch_idx
         )
+        if self.options.debug_checks:
+            self._audit_state(new_state, where="run_iteration(chunked)")
         if cfg.record_events:
             events = jax.tree.map(
                 lambda *xs: jnp.concatenate(xs, axis=1), *ev_chunks)
@@ -510,7 +550,8 @@ class Engine:
             fold = lambda t: fold_constants_batch(t, cfg.operators)
         if cfg.should_simplify:
             pops = dataclasses.replace(pops, trees=fold(pops.trees))
-        elif float(options.mutation_weights.simplify) > 0:
+        # static options-scalar read, not a traced value
+        elif float(options.mutation_weights.simplify) > 0:  # graftlint: disable=GL003
             folded = fold(pops.trees)
             from .mutation import _select_tree
 
@@ -519,7 +560,8 @@ class Engine:
             )
 
         f_calls_total = jnp.zeros((1,), jnp.float32)
-        opt_kind_on = float(options.mutation_weights.optimize) > 0
+        # static options-scalar read, not a traced value
+        opt_kind_on = float(options.mutation_weights.optimize) > 0  # graftlint: disable=GL003
         if scores is not None:
             if opt_kind_on:
                 # `optimize`-kind mutations (deferred from the cycle; see
@@ -695,7 +737,8 @@ class Engine:
         # :77-85 per-member coin flips).
         k_sel = max(1, round(P * options.optimizer_probability))
         gate_p = min(P * options.optimizer_probability / k_sel, 1.0)
-        opt_kind_on = float(options.mutation_weights.optimize) > 0
+        # static options-scalar read, not a traced value
+        opt_kind_on = float(options.mutation_weights.optimize) > 0  # graftlint: disable=GL003
         if opt_kind_on:
             # Size the selection to cover the expected number of members
             # marked by `optimize`-kind mutations this iteration (the
@@ -703,8 +746,9 @@ class Engine:
             # draw, src/Mutate.jl:571-658) — marks beyond k_sel slots
             # would otherwise be dropped.
             wvec = options.mutation_weights.as_vector()
-            frac_opt = float(options.mutation_weights.optimize) / max(
-                float(wvec.sum()), 1e-12
+            # static host numpy reads of options, not traced values
+            frac_opt = float(options.mutation_weights.optimize) / max(  # graftlint: disable=GL003
+                float(wvec.sum()), 1e-12  # graftlint: disable=GL003
             )
             import math
 
@@ -874,7 +918,8 @@ def _migrate(key, pops: PopulationState, pool: PopulationState, frac: float,
         pick = jax.random.randint(k2, (I, P), 0, n_pool)
 
     N = I * P
-    f = min(float(frac), 1.0)
+    # `frac` is a static Python float (options.fraction_replaced*)
+    f = min(float(frac), 1.0)  # graftlint: disable=GL003
     kpack = min(N, int(math.ceil(
         N * f + 3.0 * math.sqrt(N * f * (1.0 - f)) + 1.0)))
     flat_replace = replace.reshape(N)

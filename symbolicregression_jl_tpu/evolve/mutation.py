@@ -16,6 +16,8 @@ out slices, replacing ~1000 per-cycle small RNG device ops with one
 (see evolve/rng.py). Key-based wrappers remain for the random tree
 generators used at init time.
 """
+# graftlint: assume-traced — pure device-kernel module; callers jit/vmap
+# these functions from other modules, outside the module-local analysis.
 
 from __future__ import annotations
 

@@ -10,6 +10,8 @@ The generic helper takes up to NP pieces, each ``(start, len)`` into a
 combined source array (possibly the concatenation of several trees plus a
 scratch buffer of newly created nodes), and produces the output tree.
 """
+# graftlint: assume-traced — pure device-kernel module; callers jit/vmap
+# these functions from other modules, outside the module-local analysis.
 
 from __future__ import annotations
 

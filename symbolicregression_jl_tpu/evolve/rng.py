@@ -5,6 +5,8 @@ split per (island, cycle, slot, purpose) so runs are reproducible with a
 seed (deterministic-mode semantics of src/Utils.jl:14-24 fall out for
 free: device evolution is always deterministic given the key).
 """
+# graftlint: assume-traced — pure device-kernel module; callers jit/vmap
+# these functions from other modules, outside the module-local analysis.
 
 from __future__ import annotations
 
@@ -54,15 +56,20 @@ def categorical_from_weights(key, weights):
 
 
 class USlice:
-    """Static-cursor view over a flat uniform(0,1) vector."""
+    """Static-cursor view over a flat uniform(0,1) vector.
+
+    The cursor is *trace-time-only* state by design: ``i`` is a static
+    Python int advanced while the kernel traces, so every ``take``
+    lowers to a static slice. The instance never outlives one trace
+    (kernels construct it from their own ``u`` argument)."""
 
     def __init__(self, u):
-        self.u = u
-        self.i = 0
+        self.u = u  # graftlint: disable=GL005
+        self.i = 0  # graftlint: disable=GL005
 
     def take(self, n: int):
         s = jax.lax.slice_in_dim(self.u, self.i, self.i + n)
-        self.i += n
+        self.i += n  # graftlint: disable=GL005 (static trace-time cursor)
         return s
 
     def take1(self):

@@ -22,6 +22,8 @@ per iteration on the whole-population fold at the bench config (eight
   is selected by a where-chain over the (small) op tables, and the
   buffer update is a masked select — all wide VPU ops.
 """
+# graftlint: assume-traced — pure device-kernel module; callers jit/vmap
+# these functions from other modules, outside the module-local analysis.
 
 from __future__ import annotations
 

@@ -315,7 +315,9 @@ def _apply_kind(kind, u_all, tree: TreeBatch, temperature, cur_maxsize,
     branches = []
 
     def add(name, fn):
-        branches.append((_KIND[name], fn(s.take(budgets[name]))))
+        # trace-time staging: the branch table is built and fully
+        # consumed within this trace, never mutated across traces
+        branches.append((_KIND[name], fn(s.take(budgets[name]))))  # graftlint: disable=GL005
 
     add("mutate_constant", lambda u: M.mutate_constant(u, tree, temperature, mctx))
     add("mutate_operator", lambda u: M.mutate_operator(u, tree, mctx))

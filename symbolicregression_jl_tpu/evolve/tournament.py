@@ -5,6 +5,8 @@ by the adaptive-parsimony frequency factor ``cost * exp(scaling * freq)``,
 then pick the k-th best where k follows the truncated geometric place
 distribution ``p (1-p)^k`` (src/Population.jl:145-179).
 """
+# graftlint: assume-traced — pure device-kernel module; callers jit/vmap
+# these functions from other modules, outside the module-local analysis.
 
 from __future__ import annotations
 

@@ -1,0 +1,33 @@
+"""graftlint — JAX-hazard static analysis + runtime invariant auditing.
+
+Static side (pure-Python AST, no JAX import needed):
+
+- :data:`~.rules.RULES` — table-driven rule registry (GL001-GL006)
+- :func:`~.cli.lint_source` / :func:`~.cli.lint_paths` — programmatic API
+- ``python -m symbolicregression_jl_tpu.lint <paths>`` — CLI, exits
+  nonzero on findings
+
+Runtime side (imports JAX lazily via :mod:`.runtime`):
+
+- :func:`~.runtime.validate_programs` — postfix program-table invariants
+- :func:`~.runtime.compile_count_guard` — "no recompiles in this region"
+- :func:`~.runtime.no_transfer` — "no implicit host↔device transfers"
+
+The static analyzer intentionally avoids importing :mod:`jax` so the CLI
+stays usable (and fast) in environments without an accelerator stack.
+"""
+
+from .analyzer import Finding, ModuleAnalysis
+from .cli import lint_paths, lint_source, main
+from .rules import RULES, Rule, rule
+
+__all__ = [
+    "Finding",
+    "ModuleAnalysis",
+    "RULES",
+    "Rule",
+    "rule",
+    "lint_paths",
+    "lint_source",
+    "main",
+]

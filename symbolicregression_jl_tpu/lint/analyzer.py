@@ -1,0 +1,390 @@
+"""Shared AST analysis infrastructure for graftlint.
+
+The rules in :mod:`.rules` are small functions over a
+:class:`ModuleAnalysis`, which precomputes everything the JAX-hazard
+rules need from a module's source:
+
+- a parent map (``ast`` has no uplinks),
+- per-line suppression directives (``# graftlint: disable=GL003``),
+- the *traced-context* set: every function-like node whose body executes
+  under a JAX trace (``jit`` / ``vmap`` / ``scan`` / ``shard_map`` / ...),
+  including functions reached transitively through module-local calls
+  (``jax.jit(self._iteration_impl)`` marks the method, which marks the
+  helpers it calls, ...).
+
+The traced-context analysis is deliberately an over-approximation in the
+direction that matters for the rules: a function passed to any tracing
+transform is traced, and anything it calls by simple name or
+``self.<method>`` is traced too. Host-side drivers that merely *invoke*
+jitted callables (e.g. ``Engine.run_iteration``) are not traced, so
+host-side syncs there are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple, Union
+
+__all__ = [
+    "Finding",
+    "ModuleAnalysis",
+    "dotted_name",
+    "parse_suppressions",
+    "root_name",
+    "walk_pruned",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, pointing at a source line."""
+
+    rule_id: str
+    rule_name: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def __str__(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule_id}[{self.rule_name}] {self.message}"
+        )
+
+
+# ``# graftlint: disable`` suppresses every rule on the line;
+# ``# graftlint: disable=GL001,GL003`` suppresses the listed rules.
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\s-]+))?"
+)
+
+# Module-level directive for pure-device kernel modules whose callers
+# live in *other* modules (the traced-context fixpoint is module-local):
+# every function in the module is treated as a traced body.
+_ASSUME_TRACED_RE = re.compile(r"#\s*graftlint:\s*assume-traced")
+
+# Sentinel for "all rules suppressed on this line".
+ALL_RULES = None
+
+
+def parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """Map line number -> suppressed rule-id set (None = all rules).
+
+    Directives are matched textually, so a suppression string inside a
+    string literal also counts — acceptable for a repo linter, and it
+    keeps the scanner independent of tokenization errors.
+    """
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = m.group("rules")
+        if rules is None:
+            out[i] = ALL_RULES
+        else:
+            out[i] = {
+                r.strip().upper()
+                for r in rules.replace(";", ",").split(",")
+                if r.strip()
+            }
+    return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an Attribute/Subscript chain (``a`` in ``a.b[0].c``)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_pruned(node: ast.AST, prune=None):
+    """``ast.walk`` that does not descend into nested function scopes.
+
+    ``node`` itself is always yielded (even if function-like); children
+    matching ``prune`` (default: function-like nodes) are skipped whole.
+    """
+    if prune is None:
+        prune = FUNC_NODES
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, prune):
+                continue
+            stack.append(child)
+
+
+FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# Call targets whose function-valued arguments are traced by JAX. Exact
+# dotted forms as they appear in source (aliases like `from jax import
+# jit` produce the short forms).
+TRACER_CALLS = {
+    "jax.jit", "jit",
+    "jax.vmap", "vmap",
+    "jax.pmap", "pmap",
+    "jax.grad", "grad",
+    "jax.value_and_grad", "value_and_grad",
+    "jax.jacfwd", "jax.jacrev", "jax.hessian",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.map", "lax.map",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.switch", "lax.switch",
+    "jax.lax.associative_scan", "lax.associative_scan",
+    "jax.experimental.shard_map.shard_map", "shard_map", "_shard_map",
+    "jax.custom_jvp", "jax.custom_vjp",
+    "pl.pallas_call", "pallas_call",
+}
+
+_PARTIAL_CALLS = {"partial", "functools.partial"}
+
+# Pallas kernel entry points: their function argument runs with the
+# ref-mutation programming model (stores into Ref params are the idiom,
+# not a hazard).
+PALLAS_CALLS = {
+    "pl.pallas_call", "pallas_call", "jax.experimental.pallas.pallas_call",
+}
+
+
+def _is_tracer_dotted(dn: Optional[str]) -> bool:
+    return dn is not None and dn in TRACER_CALLS
+
+
+def _tracer_in_call(call: ast.Call) -> bool:
+    """True if ``call`` is a tracing transform (directly or via partial)."""
+    dn = dotted_name(call.func)
+    if _is_tracer_dotted(dn):
+        return True
+    # partial(jax.jit, static_argnums=...) used as decorator/value
+    if dn in _PARTIAL_CALLS and call.args:
+        return _is_tracer_dotted(dotted_name(call.args[0]))
+    return False
+
+
+class ModuleAnalysis:
+    """Parsed module + the shared analyses rules consume."""
+
+    def __init__(self, source: str, path: str = "<string>"):
+        self.source = source
+        self.path = path
+        self.tree = ast.parse(source)
+        self.suppressions = parse_suppressions(source)
+
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+        # Module-level import aliases (`jax`, `np`, `lax`, ...): calls
+        # like `jax.lax.sort(...)` are library functions, not method
+        # mutations of local state.
+        self.imported_names: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    self.imported_names.add(
+                        (alias.asname or alias.name).split(".")[0]
+                    )
+
+        # name -> function-like def nodes (module defs, nested defs,
+        # methods, and lambdas bound via simple assignment).
+        self._defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs_by_name.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Lambda
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._defs_by_name.setdefault(tgt.id, []).append(
+                            node.value
+                        )
+
+        self.traced: Set[ast.AST] = set()
+        self.pallas: Set[ast.AST] = set()
+        self._compute_traced()
+
+    # ------------------------------------------------------------------
+    def _resolve_func_ref(self, node: ast.AST) -> List[ast.AST]:
+        """Function-def nodes a reference may denote (over-approximate)."""
+        if isinstance(node, ast.Lambda):
+            return [node]
+        if isinstance(node, ast.Name):
+            return self._defs_by_name.get(node.id, [])
+        if isinstance(node, ast.Attribute):
+            # self._foo / cls._foo — resolve by method name anywhere in
+            # the module (class attribution is an over-approximation).
+            base = root_name(node)
+            if base in ("self", "cls"):
+                return self._defs_by_name.get(node.attr, [])
+        return []
+
+    def _compute_traced(self) -> None:
+        roots: List[ast.AST] = []
+
+        if _ASSUME_TRACED_RE.search(self.source):
+            roots.extend(self.functions())
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_tracer_dotted(dotted_name(dec)) or (
+                        isinstance(dec, ast.Call) and _tracer_in_call(dec)
+                    ):
+                        roots.append(node)
+            elif isinstance(node, ast.Call) and _tracer_in_call(node):
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        roots.append(arg)
+                    elif isinstance(arg, ast.List):
+                        # lax.switch takes a list of branches
+                        for elt in arg.elts:
+                            roots.extend(self._resolve_func_ref(elt))
+                            if isinstance(elt, ast.Lambda):
+                                roots.append(elt)
+                    else:
+                        roots.extend(self._resolve_func_ref(arg))
+
+        pallas_roots: List[ast.AST] = []
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Call)
+                and dotted_name(node.func) in PALLAS_CALLS
+            ):
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        pallas_roots.append(arg)
+                    else:
+                        pallas_roots.extend(self._resolve_func_ref(arg))
+
+        # Propagate through module-local calls: anything a traced body
+        # calls by simple name or self-attribute is traced too (same
+        # fixpoint for the pallas-kernel set).
+        for seed, out in ((roots, self.traced), (pallas_roots, self.pallas)):
+            work = list(seed)
+            while work:
+                fn = work.pop()
+                if fn in out:
+                    continue
+                out.add(fn)
+                body = fn.body if isinstance(fn.body, list) else [fn.body]
+                for stmt in body:
+                    for node in ast.walk(stmt):
+                        if isinstance(node, ast.Call):
+                            for target in self._resolve_func_ref(node.func):
+                                if target not in out:
+                                    work.append(target)
+
+    # ------------------------------------------------------------------
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, FUNC_NODES):
+            cur = self.parents.get(cur)
+        return cur
+
+    def in_pallas_kernel(self, fn: ast.AST) -> bool:
+        """Whether ``fn`` is (or is nested inside) a Pallas kernel."""
+        while fn is not None:
+            if fn in self.pallas:
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    def is_traced(self, node: ast.AST) -> bool:
+        """Whether ``node`` sits in a traced (jit/vmap/scan/...) body.
+
+        Walks up to the nearest enclosing function; if that function is
+        not itself traced, keeps walking (a helper closure defined but
+        never called inside a jitted function stays host-semantics, but
+        the calls that matter were already propagated by the traced-set
+        fixpoint)."""
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.traced:
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    def functions(self) -> Iterator[ast.AST]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, FUNC_NODES):
+                yield node
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        if line not in self.suppressions:
+            return False
+        rules = self.suppressions[line]
+        return rules is ALL_RULES or rule_id.upper() in rules
+
+
+def local_bindings(fn: ast.AST) -> Set[str]:
+    """Names bound inside a function body: params, assignments, imports,
+    for-targets, with-as, walrus, nested defs. Comprehension targets are
+    their own scope and intentionally excluded."""
+    bound: Set[str] = set()
+    if isinstance(fn, ast.Lambda):
+        args = fn.args
+        body = [fn.body]
+    else:
+        args = fn.args
+        body = fn.body
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+    ):
+        bound.add(a.arg)
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+
+    def collect_target(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            bound.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                collect_target(elt)
+        elif isinstance(t, ast.Starred):
+            collect_target(t.value)
+
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    collect_target(t)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                collect_target(node.target)
+            elif isinstance(node, ast.For):
+                collect_target(node.target)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                collect_target(node.optional_vars)
+            elif isinstance(node, ast.NamedExpr):
+                collect_target(node.target)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(node.name)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    bound.add((alias.asname or alias.name).split(".")[0])
+    return bound

@@ -1,0 +1,665 @@
+"""graftlint rule catalog — JAX hazards that pytest doesn't catch.
+
+Each rule is a function ``check(mod: ModuleAnalysis) -> Iterator[Finding]``
+registered in the table-driven :data:`RULES` registry via the
+:func:`rule` decorator. Adding a rule is ~20 lines: write the checker,
+decorate it with id/name/summary/rationale (and an optional ``scope`` of
+directory names it applies to), and it participates in the CLI, the
+suppression machinery, and ``--list-rules`` automatically.
+
+Suppression: append ``# graftlint: disable=GL003`` (or a bare
+``# graftlint: disable``) to the *reported* line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .analyzer import (
+    FUNC_NODES,
+    Finding,
+    ModuleAnalysis,
+    dotted_name,
+    local_bindings,
+    root_name,
+    walk_pruned,
+)
+
+__all__ = ["Rule", "RULES", "rule", "run_rules"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    summary: str
+    rationale: str
+    # Directory names the rule is limited to (None = whole tree). A file
+    # is in scope when any component of its path matches.
+    scope: Optional[Tuple[str, ...]]
+    check: Callable[[ModuleAnalysis], Iterator[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(
+    id: str,
+    name: str,
+    summary: str,
+    rationale: str = "",
+    scope: Optional[Sequence[str]] = None,
+):
+    def decorator(fn):
+        RULES[id] = Rule(
+            id=id,
+            name=name,
+            summary=summary,
+            rationale=rationale,
+            scope=tuple(scope) if scope else None,
+            check=fn,
+        )
+        return fn
+
+    return decorator
+
+
+def _in_scope(path: str, scope: Optional[Tuple[str, ...]]) -> bool:
+    if scope is None:
+        return True
+    parts = path.replace("\\", "/").split("/")
+    return any(p in scope for p in parts)
+
+
+def run_rules(
+    mod: ModuleAnalysis, select: Optional[Set[str]] = None
+) -> List[Finding]:
+    """All non-suppressed findings for a module, sorted by position."""
+    out: List[Finding] = []
+    for r in RULES.values():
+        if select is not None and r.id not in select:
+            continue
+        if not _in_scope(mod.path, r.scope):
+            continue
+        for f in r.check(mod):
+            if not mod.is_suppressed(f.rule_id, f.line):
+                out.append(f)
+    out.sort(key=lambda f: (f.line, f.col, f.rule_id))
+    return out
+
+
+def _finding(mod: ModuleAnalysis, rid: str, node: ast.AST, msg: str) -> Finding:
+    return Finding(
+        rule_id=rid,
+        rule_name=RULES[rid].name if rid in RULES else rid,
+        path=mod.path,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0),
+        message=msg,
+    )
+
+
+# ---------------------------------------------------------------------------
+# GL001 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+# jax.random functions that CONSUME a key as their first argument.
+# Constructors / key-data plumbing don't count, and neither does
+# `fold_in`: deriving many streams from one base key with distinct data
+# (`fold_in(key, i)` in a loop) is the canonical JAX idiom, not reuse.
+_KEY_NONCONSUMING = {
+    "key", "PRNGKey", "key_data", "wrap_key_data", "key_impl", "clone",
+    "fold_in",
+}
+
+
+def _jax_random_prefixes(mod: ModuleAnalysis) -> Tuple[str, ...]:
+    """Module prefixes denoting jax.random here. The bare ``random``
+    prefix only counts when the module does ``from jax import random`` —
+    with ``import random`` (or no import at all) it's the stdlib module
+    and first arguments are not PRNG keys."""
+    prefixes = ["jax.random", "jrandom", "jr"]
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.ImportFrom)
+            and node.module == "jax"
+            and any(a.name == "random" and a.asname is None
+                    for a in node.names)
+        ):
+            prefixes.append("random")
+            break
+    return tuple(prefixes)
+
+
+def _random_key_call(
+    call: ast.Call, prefixes: Tuple[str, ...]
+) -> Optional[str]:
+    """The consumed-key variable name if this is a key-consuming
+    jax.random call with a plain-Name key, else None."""
+    dn = dotted_name(call.func)
+    if dn is None or "." not in dn:
+        return None
+    mod_, fn = dn.rsplit(".", 1)
+    if mod_ not in prefixes or fn in _KEY_NONCONSUMING:
+        return None
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    return None
+
+
+@rule(
+    "GL001",
+    "key-reuse",
+    "jax.random key consumed more than once without a split",
+    "Reusing a PRNG key yields identical 'random' draws: correlated "
+    "mutations, duplicated restarts, silently degraded search. Every "
+    "consumption (samplers, split, fold_in) must use a fresh key.",
+)
+def check_key_reuse(mod: ModuleAnalysis) -> Iterator[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[int, int, str]] = set()
+    prefixes = _jax_random_prefixes(mod)
+
+    def emit(node: ast.AST, name: str) -> None:
+        key = (node.lineno, node.col_offset, name)
+        if key not in seen:
+            seen.add(key)
+            findings.append(
+                _finding(
+                    mod,
+                    "GL001",
+                    node,
+                    f"PRNG key `{name}` is consumed again without an "
+                    f"intervening rebind from `jax.random.split`/`fold_in`",
+                )
+            )
+
+    def reset_target(t: ast.AST, env: Dict[str, bool]) -> None:
+        if isinstance(t, ast.Name):
+            env[t.id] = False
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                reset_target(elt, env)
+        elif isinstance(t, ast.Starred):
+            reset_target(t.value, env)
+
+    def visit_expr(e: Optional[ast.AST], env: Dict[str, bool]) -> None:
+        if e is None:
+            return
+        # walk_pruned: nested lambda/def scopes get their own pass
+        for node in walk_pruned(e):
+            if isinstance(node, ast.Call):
+                name = _random_key_call(node, prefixes)
+                if name is not None:
+                    if env.get(name, False):
+                        emit(node, name)
+                    env[name] = True
+
+    def visit_stmts(stmts: Sequence[ast.stmt], env: Dict[str, bool]) -> None:
+        for s in stmts:
+            if isinstance(s, FUNC_NODES + (ast.ClassDef,)):
+                continue  # separate scope
+            if isinstance(s, ast.If):
+                visit_expr(s.test, env)
+                env_a, env_b = dict(env), dict(env)
+                visit_stmts(s.body, env_a)
+                visit_stmts(s.orelse, env_b)
+                for k in set(env_a) | set(env_b):
+                    env[k] = env_a.get(k, False) or env_b.get(k, False)
+            elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+                visit_expr(getattr(s, "iter", None), env)
+                visit_expr(getattr(s, "test", None), env)
+                # two passes: the second catches keys consumed every
+                # iteration without a rebind (dedup keeps one finding)
+                for _ in range(2):
+                    body_env = dict(env)
+                    if isinstance(s, (ast.For, ast.AsyncFor)):
+                        reset_target(s.target, body_env)
+                    visit_stmts(s.body, body_env)
+                    env.update(body_env)
+                visit_stmts(s.orelse, env)
+            elif isinstance(s, ast.Try):
+                visit_stmts(s.body, env)
+                for h in s.handlers:
+                    visit_stmts(h.body, dict(env))
+                visit_stmts(s.orelse, env)
+                visit_stmts(s.finalbody, env)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    visit_expr(item.context_expr, env)
+                visit_stmts(s.body, env)
+            else:
+                for child in ast.iter_child_nodes(s):
+                    if isinstance(child, ast.expr):
+                        visit_expr(child, env)
+                if isinstance(s, ast.Assign):
+                    for t in s.targets:
+                        reset_target(t, env)
+                elif isinstance(s, (ast.AnnAssign, ast.AugAssign)):
+                    reset_target(s.target, env)
+
+    # module body + every function body, each with a fresh environment
+    visit_stmts(
+        [s for s in mod.tree.body], {}
+    )
+    for fn in mod.functions():
+        if isinstance(fn, ast.Lambda):
+            visit_expr(fn.body, {})
+        else:
+            visit_stmts(fn.body, {})
+    yield from findings
+
+
+# ---------------------------------------------------------------------------
+# GL002 — host RNG in device-code directories
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "GL002",
+    "host-rng",
+    "Python `random` / `np.random` used in device-code directories",
+    "Host RNG calls are invisible to jit, ignore the threaded "
+    "jax.random keys (breaking seeded reproducibility), and bake a "
+    "single host draw into the traced program as a constant.",
+    scope=("evolve", "ops"),
+)
+def check_host_rng(mod: ModuleAnalysis) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = dotted_name(node.func)
+        if dn is None:
+            continue
+        if dn.startswith(("np.random.", "numpy.random.")):
+            yield _finding(
+                mod, "GL002", node,
+                f"`{dn}` draws from the host numpy RNG; use the threaded "
+                f"`jax.random` key plumbing instead",
+            )
+        elif dn.startswith("random.") and not dn.startswith(
+            ("jax.random.", "np.random.", "numpy.random.")
+        ):
+            yield _finding(
+                mod, "GL002", node,
+                f"`{dn}` uses Python's global `random` module; use the "
+                f"threaded `jax.random` key plumbing instead",
+            )
+
+
+# ---------------------------------------------------------------------------
+# GL003 — device-scalar materialization inside traced code
+# ---------------------------------------------------------------------------
+
+_SYNC_CALLS = {
+    "float", "int", "bool",
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "np.float32", "np.float64", "np.int32", "np.int64",
+    "numpy.float32", "numpy.float64", "numpy.int32", "numpy.int64",
+    "jax.device_get", "device_get",
+}
+_SYNC_METHODS = {"item", "tolist", "to_py"}
+# Calls whose result is a host scalar regardless of input (a traced
+# value passed to them would already have errored) — casting it is
+# noise, not a sync. Matched on the last dotted component so module
+# aliases (`math`/`_math`) don't matter.
+_STATIC_RESULT_FNS = {
+    "len", "round", "ord", "hash", "id", "prod", "ceil", "floor", "sqrt",
+}
+
+
+def _is_host_literal(node: ast.AST) -> bool:
+    """Expressions that are host containers by construction (list/tuple
+    displays, comprehensions, or `or`-chains of those) — np.asarray on
+    them is trace-time table building, not a device sync."""
+    if isinstance(node, (ast.List, ast.Tuple, ast.Dict, ast.Set,
+                         ast.ListComp, ast.DictComp, ast.SetComp,
+                         ast.GeneratorExp)):
+        return True
+    if isinstance(node, ast.BoolOp):
+        return all(_is_host_literal(v) for v in node.values)
+    return False
+
+
+@rule(
+    "GL003",
+    "traced-sync",
+    "host materialization (`float()`/`.item()`/`np.asarray`) in a "
+    "jit/vmap/scan body",
+    "Materializing a traced value on the host forces a blocking "
+    "device→host sync at trace time and a ConcretizationTypeError on "
+    "abstract values; in the evolve hot loop a single stray `.item()` "
+    "serializes the pipeline. Static Python-scalar reads (e.g. options "
+    "fields) are legitimate — annotate those with "
+    "`# graftlint: disable=GL003`.",
+)
+def check_traced_sync(mod: ModuleAnalysis) -> Iterator[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not mod.is_traced(node):
+            continue
+        dn = dotted_name(node.func)
+        if dn in _SYNC_CALLS:
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) or _is_host_literal(arg):
+                continue  # float("nan"), np.asarray([...]): host values
+            if isinstance(arg, ast.Call):
+                adn = dotted_name(arg.func)
+                if adn and adn.rsplit(".", 1)[-1] in _STATIC_RESULT_FNS:
+                    continue  # float(len(xs)), int(math.ceil(...)): host
+            yield _finding(
+                mod, "GL003", node,
+                f"`{dn}(...)` inside a traced body materializes its "
+                f"argument on the host (device sync / concretization "
+                f"error on traced values)",
+            )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SYNC_METHODS
+            and not node.args
+        ):
+            yield _finding(
+                mod, "GL003", node,
+                f"`.{node.func.attr}()` inside a traced body forces a "
+                f"blocking device→host transfer",
+            )
+
+
+# ---------------------------------------------------------------------------
+# GL004 — recompilation hazards
+# ---------------------------------------------------------------------------
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node if ``node`` is ``jax.jit(...)`` / ``jit(...)`` /
+    ``partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    dn = dotted_name(node.func)
+    if dn in ("jax.jit", "jit"):
+        return node
+    if dn in ("partial", "functools.partial") and node.args:
+        if dotted_name(node.args[0]) in ("jax.jit", "jit"):
+            return node
+    return None
+
+
+def _static_positions(jit: ast.Call) -> Tuple[List[int], List[str]]:
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in jit.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.append(e.value)
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.append(e.value)
+    return nums, names
+
+
+_UNHASHABLE_VALUE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+                     ast.DictComp)
+_ARRAY_CTORS = {
+    "np.array", "np.asarray", "numpy.array", "numpy.asarray",
+    "jnp.array", "jnp.asarray", "jax.numpy.array", "jax.numpy.asarray",
+    "np.zeros", "np.ones", "jnp.zeros", "jnp.ones",
+}
+
+
+def _is_unhashable_arg(arg: ast.AST) -> bool:
+    if isinstance(arg, _UNHASHABLE_VALUE):
+        return True
+    if isinstance(arg, ast.Call) and dotted_name(arg.func) in _ARRAY_CTORS:
+        return True
+    return False
+
+
+@rule(
+    "GL004",
+    "recompile-hazard",
+    "jit wrapper rebuilt per call/iteration, or non-hashable static arg",
+    "A `jax.jit` wrapper built inside a loop (or invoked inline as "
+    "`jax.jit(f)(x)`) is a fresh cache every time — each call retraces "
+    "and recompiles. Non-hashable values (lists, dicts, arrays) passed "
+    "for `static_argnums` positions raise or, worse, force a recompile "
+    "per distinct object.",
+)
+def check_recompile_hazard(mod: ModuleAnalysis) -> Iterator[Finding]:
+    # (a) jax.jit(f)(...) — wrapper discarded after one call
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _jit_call(node.func) is not None:
+            yield _finding(
+                mod, "GL004", node,
+                "`jax.jit(...)` invoked inline builds a fresh wrapper "
+                "(and cache) per call; bind the jitted function once "
+                "outside the call site",
+            )
+
+    # (b) jit of a lambda / locally-defined function inside a loop body
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for node in ast.walk(loop):
+            if node is loop:
+                continue
+            jc = _jit_call(node)
+            if jc is not None and not (
+                isinstance(mod.parents.get(jc), ast.Call)
+                and mod.parents[jc].func is jc
+            ):
+                yield _finding(
+                    mod, "GL004", node,
+                    "jit wrapper constructed inside a loop body — the "
+                    "compilation cache is dropped and rebuilt every "
+                    "iteration; hoist the `jax.jit` call out of the loop",
+                )
+                break  # one finding per loop is enough signal
+
+    # (c) non-hashable literals passed at static positions of a wrapper
+    # jitted in the same module: g = jax.jit(f, static_argnums=(1,));
+    # ... g(x, [1, 2]) ...
+    static_of: Dict[str, Tuple[List[int], List[str]]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            jc = _jit_call(node.value)
+            if isinstance(tgt, ast.Name) and jc is not None:
+                nums, names = _static_positions(jc)
+                if nums or names:
+                    static_of[tgt.id] = (nums, names)
+    if static_of:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname not in static_of:
+                continue
+            nums, names = static_of[fname]
+            for i in nums:
+                if i < len(node.args) and _is_unhashable_arg(node.args[i]):
+                    yield _finding(
+                        mod, "GL004", node.args[i],
+                        f"non-hashable value passed for static_argnums "
+                        f"position {i} of `{fname}` — static arguments "
+                        f"must be hashable (tuples, not lists/arrays)",
+                    )
+            for kw in node.keywords:
+                if kw.arg in names and _is_unhashable_arg(kw.value):
+                    yield _finding(
+                        mod, "GL004", kw.value,
+                        f"non-hashable value passed for static argname "
+                        f"`{kw.arg}` of `{fname}` — static arguments "
+                        f"must be hashable (tuples, not lists/arrays)",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# GL005 — mutation of captured state inside traced bodies
+# ---------------------------------------------------------------------------
+
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "remove", "pop", "clear", "update",
+    "add", "discard", "setdefault", "popitem", "sort", "reverse",
+}
+
+
+@rule(
+    "GL005",
+    "captured-mutation",
+    "mutation of closure/parameter state inside a jit/vmap/scan body",
+    "Side effects on captured Python state execute ONCE at trace time, "
+    "then never again: counters stay stale, accumulator lists hold "
+    "tracers, and retraces silently re-run the mutation. Traced code "
+    "must be functionally pure; thread state through carries/returns.",
+)
+def check_captured_mutation(mod: ModuleAnalysis) -> Iterator[Finding]:
+    for fn in mod.functions():
+        if fn not in mod.traced:
+            continue
+        # Pallas kernels mutate Ref parameters by design — that IS the
+        # programming model; skip them (and their nested helpers).
+        in_pallas = mod.in_pallas_kernel(fn)
+        bound = local_bindings(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        # nested defs are separate scopes with their own iteration
+        body = [s for s in body if not isinstance(s, FUNC_NODES + (ast.ClassDef,))]
+
+        nonlocals: Set[str] = set()
+        for stmt in body:
+            for node in walk_pruned(stmt):
+                if isinstance(node, (ast.Nonlocal, ast.Global)):
+                    nonlocals.update(node.names)
+
+        def is_foreign(base: Optional[str]) -> bool:
+            # parameters count: mutating an argument mutates caller state
+            if base is None:
+                return False
+            if isinstance(fn, ast.Lambda):
+                params = {a.arg for a in fn.args.args}
+            else:
+                params = {
+                    a.arg
+                    for a in (
+                        list(fn.args.posonlyargs)
+                        + list(fn.args.args)
+                        + list(fn.args.kwonlyargs)
+                    )
+                }
+            return base in params or base not in bound
+
+        for stmt in body:
+            for node in walk_pruned(stmt):
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, (ast.Subscript, ast.Attribute)):
+                            if in_pallas and isinstance(t, ast.Subscript):
+                                continue  # Ref stores are the idiom
+                            base = root_name(t)
+                            if is_foreign(base):
+                                kind = (
+                                    "subscript"
+                                    if isinstance(t, ast.Subscript)
+                                    else "attribute"
+                                )
+                                yield _finding(
+                                    mod, "GL005", node,
+                                    f"{kind} store on `{base}` mutates "
+                                    f"captured state inside a traced body "
+                                    f"(runs once at trace time only)",
+                                )
+                        elif isinstance(t, ast.Name) and t.id in nonlocals:
+                            yield _finding(
+                                mod, "GL005", node,
+                                f"write to {'nonlocal/global'} `{t.id}` "
+                                f"inside a traced body runs once at trace "
+                                f"time only",
+                            )
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATING_METHODS
+                ):
+                    base = root_name(node.func.value)
+                    if base in mod.imported_names:
+                        continue  # jax.lax.sort etc.: library calls
+                    if is_foreign(base):
+                        yield _finding(
+                            mod, "GL005", node,
+                            f"`{base}.{node.func.attr}(...)` mutates "
+                            f"captured state inside a traced body (runs "
+                            f"once at trace time only)",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# GL006 — debug prints / callbacks in non-debug paths
+# ---------------------------------------------------------------------------
+
+_DEBUG_CALLS = {
+    "jax.debug.print", "debug.print",
+    "jax.debug.callback", "debug.callback",
+    "jax.debug.breakpoint", "debug.breakpoint",
+    "jax.debug.visualize_array_sharding",
+}
+
+
+@rule(
+    "GL006",
+    "stray-debug",
+    "`jax.debug.print`/`callback` outside a guarded debug path",
+    "jax.debug hooks insert host callbacks into the compiled program: "
+    "they serialize dispatch, defeat donation/fusion, and on TPU stall "
+    "the whole step on the host round-trip. They belong behind an "
+    "explicit debug flag or in *debug* modules only.",
+)
+def check_stray_debug(mod: ModuleAnalysis) -> Iterator[Finding]:
+    import os
+
+    base = os.path.basename(mod.path).lower()
+    if "debug" in base:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = dotted_name(node.func)
+        if dn not in _DEBUG_CALLS:
+            continue
+        # allowed when an enclosing function or guarding `if` mentions
+        # debug (e.g. `if options.debug_checks:`)
+        allowed = False
+        cur = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if "debug" in cur.name.lower():
+                    allowed = True
+                    break
+            if isinstance(cur, ast.If):
+                try:
+                    test_src = ast.unparse(cur.test)
+                except Exception:  # pragma: no cover
+                    test_src = ""
+                if "debug" in test_src.lower():
+                    allowed = True
+                    break
+            cur = mod.parents.get(cur)
+        if not allowed:
+            yield _finding(
+                mod, "GL006", node,
+                f"`{dn}` in a non-debug path inserts a host callback "
+                f"into the compiled program; guard it behind a debug "
+                f"flag or move it to a debug module",
+            )

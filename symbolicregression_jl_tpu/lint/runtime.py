@@ -1,0 +1,373 @@
+"""Runtime invariant auditing for the postfix program tables + hot-loop
+budget guards.
+
+Three tools, all debug-tier (none belongs in a jitted hot path):
+
+- :func:`validate_programs` / :func:`check_programs` — structural
+  invariants of the padded postfix encoding (see ops/encoding.py): a
+  corrupt table evaluates without error but silently produces garbage
+  genomes, so mutation/crossover machinery changes should run under this
+  checker (``options.debug_checks`` wires it into the Engine).
+- :func:`compile_count_guard` — context manager bounding how many XLA
+  compilations (traces) may happen in a region; pins the "warm evolve
+  cycle compiles nothing" property.
+- :func:`no_transfer` — thin wrapper over :func:`jax.transfer_guard`
+  asserting no *implicit* host↔device transfers in a region (explicit
+  ``jnp.asarray``/``device_get`` calls stay allowed).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+
+from ..ops.encoding import LEAF_CONST, LEAF_PARAM, LEAF_VAR, MAX_ARITY
+
+__all__ = [
+    "ProgramInvariantError",
+    "CompileBudgetExceeded",
+    "CompileStats",
+    "check_programs",
+    "validate_programs",
+    "compile_count_guard",
+    "no_transfer",
+]
+
+
+class ProgramInvariantError(AssertionError):
+    """A postfix program table violates a structural invariant."""
+
+
+class CompileBudgetExceeded(AssertionError):
+    """More XLA compilations happened in a guarded region than allowed."""
+
+
+def _resolve_nops(operators) -> Tuple[int, ...]:
+    """Per-arity operator counts (index d-1 = arity d) from an
+    OperatorSet, a dict {arity: n}, or a plain sequence."""
+    if operators is None:
+        return ()
+    if hasattr(operators, "nops_tuple"):
+        return tuple(operators.nops_tuple())
+    if isinstance(operators, dict):
+        ma = max(operators) if operators else 0
+        return tuple(int(operators.get(d, 0)) for d in range(1, ma + 1))
+    return tuple(int(n) for n in operators)
+
+
+def _subtree_sizes(arity: np.ndarray, D: np.ndarray) -> np.ndarray:
+    """Subtree sizes per slot via the postfix prefix-sum identity
+    (numpy mirror of ops/encoding._structure_from_arity):
+    ``start(k) = max{ j <= k : D(j-1) == D(k) - 1 }``, size = k-start+1.
+
+    O(N*L) memory and one Python loop over the L slots — the auditor
+    runs on full device-scale populations (debug_checks pulls every
+    island every iteration), so an [N, L, L] one-hot formulation is out.
+    """
+    N, L = arity.shape
+    Dm1 = D - (1 - arity)  # exclusive prefix sum
+    rows = np.arange(N)
+    # last_at[n, h] = most recent slot j <= k with Dm1[n, j] == h.
+    # Heights live in [0, L]; one extra bucket absorbs clipped garbage.
+    last_at = np.full((N, L + 2), -1, np.int64)
+    start = np.empty((N, L), np.int64)
+    for k in range(L):
+        last_at[rows, np.clip(Dm1[:, k], 0, L + 1)] = k
+        start[:, k] = last_at[rows, np.clip(D[:, k] - 1, 0, L + 1)]
+    start = np.clip(start, 0, np.arange(L)[None, :])
+    return np.arange(L)[None, :] - start + 1, start
+
+
+def check_programs(
+    trees,
+    operators=None,
+    *,
+    nfeatures: Optional[int] = None,
+    n_params: Optional[int] = None,
+    strict_padding: bool = False,
+    max_report: int = 10,
+) -> List[str]:
+    """Check every tree in a (arbitrarily batched) TreeBatch; return a
+    list of human-readable violation strings (empty = all invariants
+    hold). Device arrays are pulled to host — debug-tier only.
+
+    Invariants (ops/encoding.py module docstring):
+
+    1. ``1 <= length <= L`` — at least the root slot is used.
+    2. ``0 <= arity <= MAX_ARITY`` everywhere.
+    3. op-code ranges: leaves in {LEAF_CONST, LEAF_VAR, LEAF_PARAM};
+       arity-d operators index into the d-ary table (``op < nops[d-1]``).
+    4. postfix stack discipline over used slots: the running stack
+       height ``D(k) = sum_{j<=k} (1 - arity_j)`` stays >= 1 and ends at
+       exactly 1 — equivalently every subtree occupies the contiguous
+       span ``[k - size_k + 1, k]``.
+    5. span recurrence: for every operator node the child subtree spans
+       tile its own span exactly (binary: ``size_k = 1 + size_{k-1} +
+       size_{k-1-size_{k-1}}``; unary: ``size_k = 1 + size_{k-1}``).
+    6. padding cleanliness: slots ``k >= length`` hold arity 0 — the
+       structural derivations (ops/encoding._structure_from_arity) run
+       over the full slot axis, so a stray operator arity in padding
+       corrupts the prefix-sum algebra for the whole tree. With
+       ``strict_padding=True`` op/feat/const must be zeroed too
+       (canonical form; the generators do not maintain this, but
+       canonicalized tables dedup/hash exactly).
+    7. optional leaf-payload ranges: variable features in
+       ``[0, nfeatures)``, parameter indices in ``[0, n_params)``.
+    """
+    arity = np.asarray(trees.arity)
+    op = np.asarray(trees.op)
+    feat = np.asarray(trees.feat)
+    const = np.asarray(trees.const)
+    length = np.asarray(trees.length)
+
+    L = arity.shape[-1]
+    arity = arity.reshape(-1, L).astype(np.int64)
+    op = op.reshape(-1, L).astype(np.int64)
+    feat = feat.reshape(-1, L).astype(np.int64)
+    const = const.reshape(-1, L)
+    length = length.reshape(-1).astype(np.int64)
+    N = arity.shape[0]
+    nops = _resolve_nops(operators)
+
+    msgs: List[str] = []
+
+    def report(mask: np.ndarray, fmt) -> None:
+        idx = np.flatnonzero(mask)
+        room = max(0, max_report - len(msgs))
+        for i in idx[:room]:
+            msgs.append(fmt(int(i)))
+        omitted = len(idx) - min(room, len(idx))
+        if omitted > 0:
+            msgs.append(
+                f"... (+{omitted} more of this kind, report truncated)"
+            )
+
+    # 1. length bounds
+    bad_len = (length < 1) | (length > L)
+    report(bad_len, lambda i: (
+        f"tree {i}: length {length[i]} outside [1, {L}]"
+    ))
+    if bad_len.any():
+        # downstream masks index with length; clamp to keep going
+        length = np.clip(length, 1, L)
+
+    used = np.arange(L)[None, :] < length[:, None]
+
+    # 2. arity range
+    bad_arity = used & ((arity < 0) | (arity > MAX_ARITY))
+    report(bad_arity.any(axis=1), lambda i: (
+        f"tree {i}: arity outside [0, {MAX_ARITY}] at slots "
+        f"{np.flatnonzero(bad_arity[i]).tolist()}"
+    ))
+
+    # 3. op-code ranges
+    is_leaf = arity == 0
+    bad_leaf = used & is_leaf & (
+        (op < LEAF_CONST) | (op > LEAF_PARAM)
+    )
+    report(bad_leaf.any(axis=1), lambda i: (
+        f"tree {i}: leaf op code outside "
+        f"{{{LEAF_CONST},{LEAF_VAR},{LEAF_PARAM}}} at slots "
+        f"{np.flatnonzero(bad_leaf[i]).tolist()}"
+    ))
+    if nops:
+        for d in range(1, len(nops) + 1):
+            sel = used & (arity == d)
+            bad_op = sel & ((op < 0) | (op >= max(nops[d - 1], 1)))
+            if nops[d - 1] == 0:
+                bad_op = sel  # arity with no operators at all
+            report(bad_op.any(axis=1), lambda i, d=d, bad=bad_op: (
+                f"tree {i}: arity-{d} op index outside "
+                f"[0, {nops[d - 1]}) at slots "
+                f"{np.flatnonzero(bad[i]).tolist()}"
+            ))
+
+    # 4. stack discipline (subtree contiguity)
+    safe_arity = np.clip(arity, 0, MAX_ARITY)
+    step = np.where(used, 1 - safe_arity, 0)
+    D = np.cumsum(step, axis=1)
+    under = used & (D < 1)
+    report(under.any(axis=1), lambda i: (
+        f"tree {i}: postfix stack underflow at slot "
+        f"{int(np.flatnonzero(under[i])[0])} (operator consumes "
+        f"operands that don't exist — subtree contiguity broken)"
+    ))
+    final = D[np.arange(N), length - 1]
+    bad_final = (~under.any(axis=1)) & (final != 1)
+    report(bad_final, lambda i: (
+        f"tree {i}: postfix stack ends at height {int(final[i])} "
+        f"(expected 1) — {int(final[i]) - 1} unrooted subtree(s)"
+    ))
+
+    # 5. span recurrence (independent contiguity cross-check via the
+    #    [k - size_k + 1, k] property)
+    structurally_ok = ~(under.any(axis=1) | bad_final | bad_arity.any(axis=1))
+    if structurally_ok.any():
+        sizes, start = _subtree_sizes(safe_arity, D)
+        k = np.arange(L)[None, :]
+        un = used & (safe_arity == 1)
+        bin_ = used & (safe_arity == 2)
+        prev = np.maximum(k - 1, 0)
+        size_prev = np.take_along_axis(sizes, prev, axis=1)
+        left_root = np.maximum(k - 1 - size_prev, 0)
+        size_left = np.take_along_axis(sizes, left_root, axis=1)
+        bad_un = un & (sizes != 1 + size_prev)
+        bad_bin = bin_ & (sizes != 1 + size_prev + size_left)
+        bad_span = (bad_un | bad_bin) & structurally_ok[:, None]
+        report(bad_span.any(axis=1), lambda i: (
+            f"tree {i}: child spans do not tile the subtree span at "
+            f"slots {np.flatnonzero(bad_span[i]).tolist()}"
+        ))
+
+    # 6. padding cleanliness
+    pad = ~used
+    dirty_arity = pad & (arity != 0)
+    report(dirty_arity.any(axis=1), lambda i: (
+        f"tree {i}: nonzero arity in padding slots "
+        f"{np.flatnonzero(dirty_arity[i]).tolist()} (corrupts the "
+        f"full-axis structural prefix sums)"
+    ))
+    if strict_padding:
+        dirty = pad & ((op != 0) | (feat != 0) | (const != 0))
+        report(dirty.any(axis=1), lambda i: (
+            f"tree {i}: padding slots "
+            f"{np.flatnonzero(dirty[i]).tolist()} not zeroed "
+            f"(non-canonical table: hashing/dedup equality breaks)"
+        ))
+
+    # 7. leaf payload ranges
+    if nfeatures is not None:
+        var = used & is_leaf & (op == LEAF_VAR)
+        bad_feat = var & ((feat < 0) | (feat >= nfeatures))
+        report(bad_feat.any(axis=1), lambda i: (
+            f"tree {i}: variable feature outside [0, {nfeatures}) at "
+            f"slots {np.flatnonzero(bad_feat[i]).tolist()}"
+        ))
+    if n_params is not None:
+        par = used & is_leaf & (op == LEAF_PARAM)
+        bad_par = par & ((feat < 0) | (feat >= max(n_params, 1)))
+        if n_params == 0:
+            bad_par = par
+        report(bad_par.any(axis=1), lambda i: (
+            f"tree {i}: parameter index outside [0, {n_params}) at "
+            f"slots {np.flatnonzero(bad_par[i]).tolist()}"
+        ))
+
+    return msgs
+
+
+def validate_programs(
+    trees,
+    operators=None,
+    *,
+    nfeatures: Optional[int] = None,
+    n_params: Optional[int] = None,
+    where: str = "",
+    strict_padding: bool = False,
+    max_report: int = 10,
+) -> int:
+    """Raise :class:`ProgramInvariantError` on any violation; return the
+    number of trees checked when clean. Debug wrapper for
+    mutation/crossover outputs (``options.debug_checks=True`` calls this
+    on every engine state)."""
+    msgs = check_programs(
+        trees, operators, nfeatures=nfeatures, n_params=n_params,
+        strict_padding=strict_padding, max_report=max_report,
+    )
+    if msgs:
+        ctx = f" [{where}]" if where else ""
+        raise ProgramInvariantError(
+            f"postfix program table invariants violated{ctx}:\n  "
+            + "\n  ".join(msgs)
+        )
+    n = int(np.prod(np.asarray(trees.length).shape)) or 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Hot-loop budget guards
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CompileStats:
+    """Counters filled in by :func:`compile_count_guard`.
+
+    ``traces`` counts end-to-end jaxpr traces — every compilation starts
+    with one, *including* programs served from the persistent
+    compilation cache (which still pay trace + lowering, just not XLA).
+    ``backend_compiles`` counts actual XLA backend compilations. A warm
+    jitted hot loop should add ZERO of either."""
+
+    traces: int = 0
+    backend_compiles: int = 0
+
+
+@contextlib.contextmanager
+def compile_count_guard(
+    max_compiles: Optional[int] = None, *, what: str = "guarded region"
+) -> Iterator[CompileStats]:
+    """Count XLA compilations in a region via ``jax.monitoring`` events;
+    raise :class:`CompileBudgetExceeded` when ``max_compiles`` (compared
+    against the trace count) is exceeded.
+
+    Usage::
+
+        engine.run_iteration(state, data, maxsize)       # warm-up
+        with compile_count_guard(max_compiles=0):
+            engine.run_iteration(state2, data, maxsize)  # must be cached
+    """
+    from jax._src import monitoring
+
+    stats = CompileStats()
+    active = [True]
+
+    def on_duration(name: str, secs: float, **kw) -> None:
+        if not active[0]:
+            return
+        if name.endswith("jaxpr_trace_duration"):
+            stats.traces += 1
+        elif name.endswith("backend_compile_duration") or name.endswith(
+            "backend_compile_time"
+        ):
+            stats.backend_compiles += 1
+
+    monitoring.register_event_duration_secs_listener(on_duration)
+    try:
+        yield stats
+    finally:
+        active[0] = False
+        unreg = getattr(
+            monitoring, "_unregister_event_duration_listener_by_callback",
+            None,
+        )
+        if unreg is not None:  # pragma: no branch
+            try:
+                unreg(on_duration)
+            except Exception:  # pragma: no cover - best-effort cleanup
+                pass
+    if max_compiles is not None and stats.traces > max_compiles:
+        raise CompileBudgetExceeded(
+            f"{what}: {stats.traces} compilation(s) "
+            f"({stats.backend_compiles} reached the XLA backend), budget "
+            f"is {max_compiles} — a shape/static-argument/key-dtype "
+            f"change is defeating the jit cache in the hot loop"
+        )
+
+
+def no_transfer(level: str = "disallow"):
+    """Context manager asserting no *implicit* host↔device transfers.
+
+    Thin wrapper over :func:`jax.transfer_guard`: ``"disallow"`` raises
+    on implicit transfers (e.g. ``np.asarray(device_array)``, traced
+    ``float()`` casts, host scalars silently uploaded per step) while
+    explicit ``jnp.asarray`` / ``jax.device_get`` / ``jax.device_put``
+    remain allowed. Use ``"disallow_explicit"`` to forbid those too, or
+    ``"log"`` to locate offenders without failing.
+    """
+    return jax.transfer_guard(level)

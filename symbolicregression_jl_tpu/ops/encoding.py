@@ -44,6 +44,7 @@ __all__ = [
     "encode_tree",
     "decode_tree",
     "encode_population",
+    "postfix_valid",
     "tree_structure_arrays",
     "lane_take",
 ]
@@ -235,6 +236,38 @@ def decode_population(batch: TreeBatch, operators: OperatorSet) -> List[Node]:
         decode_tree(arity[i], op[i], feat[i], const[i], length[i], operators)
         for i in range(arity.shape[0])
     ]
+
+
+def postfix_valid(arity: jax.Array, length: jax.Array) -> jax.Array:
+    """Device-side postfix validity predicate, ``[..., L] -> bool [...]``.
+
+    True iff the length is in bounds, every used slot's arity is in
+    ``[0, MAX_ARITY]``, padding slots hold arity 0, and the running
+    postfix stack height ``D(k) = sum_{j<=k} (1 - arity_j)`` stays >= 1
+    over used slots and ends at exactly 1 — equivalently, every subtree
+    occupies the contiguous span ``[k - size_k + 1, k]`` and exactly one
+    root remains.
+
+    This is the device-cheap structural subset of
+    ``lint.runtime.check_programs`` (which also checks op-code/leaf
+    payload ranges and produces per-tree diagnoses, at the cost of a
+    host pull): usable inside jitted debug paths, e.g. to gate a
+    mutation output with ``jnp.where(postfix_valid(...), new, old)`` or
+    feed an ``equinox``-style runtime assert.
+    """
+    L = arity.shape[-1]
+    k = jnp.arange(L, dtype=jnp.int32)
+    used = k < length[..., None]
+    arity_ok = jnp.all(
+        jnp.where(used, (arity >= 0) & (arity <= MAX_ARITY), arity == 0),
+        axis=-1,
+    )
+    D = jnp.cumsum(jnp.where(used, 1 - arity, 0), axis=-1)
+    no_underflow = jnp.all(jnp.where(used, D >= 1, True), axis=-1)
+    root = jnp.clip(length[..., None] - 1, 0, L - 1)
+    final = jnp.take_along_axis(D, root, axis=-1)[..., 0]
+    len_ok = (length >= 1) & (length <= L)
+    return len_ok & arity_ok & no_underflow & (final == 1)
 
 
 # ---------------------------------------------------------------------------

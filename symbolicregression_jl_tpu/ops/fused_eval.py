@@ -825,8 +825,9 @@ def fused_loss_multi(
 
 # Fixed odd multipliers for the 3 independent linear hashes (int32
 # wraparound math; hash collisions only affect sort adjacency — the
-# grouping below is exact-verified on the sorted rows).
-_HASH_R = np.random.default_rng(0xC0FFEE).integers(
+# grouping below is exact-verified on the sorted rows). Module-level
+# fixed-seed constant, deterministic by construction — not search RNG.
+_HASH_R = np.random.default_rng(0xC0FFEE).integers(  # graftlint: disable=GL002
     1, 2**31, size=(3, 4096), dtype=np.int64).astype(np.int32) | 1
 
 

@@ -52,8 +52,17 @@ def initialize_multihost(
     Idempotent when already initialized; a quiet no-op on a single host
     with no cluster arguments/environment.
     """
-    if jax.distributed.is_initialized():
-        return
+    # jax.distributed.is_initialized only exists on newer jax; on older
+    # versions the probe is the distributed client handle.
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:  # pragma: no cover - newer jax only
+        if is_init():
+            return
+    else:
+        from jax._src.distributed import global_state
+
+        if getattr(global_state, "client", None) is not None:
+            return
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

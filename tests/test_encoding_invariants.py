@@ -1,0 +1,286 @@
+"""Property-style tests for lint.runtime.validate_programs: thousands of
+randomly generated, mutated and crossed-over programs must satisfy every
+postfix-table invariant (the machinery-correctness property the ISSUE
+pins), and hand-corrupted tables must each be caught with a specific
+diagnosis.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from symbolicregression_jl_tpu.evolve.mutation import (
+    MutationContext,
+    add_node,
+    branch_nu,
+    crossover_trees,
+    delete_node,
+    gen_random_tree_fixed_size,
+    mutate_constant,
+    mutate_operator,
+    rotate_tree,
+    swap_operands,
+)
+from symbolicregression_jl_tpu.lint.runtime import (
+    ProgramInvariantError,
+    check_programs,
+    validate_programs,
+)
+from symbolicregression_jl_tpu.ops.encoding import TreeBatch, postfix_valid
+from symbolicregression_jl_tpu.ops.operators import OperatorSet
+
+
+@pytest.fixture(scope="module")
+def ops():
+    return OperatorSet(
+        binary_operators=["+", "-", "*", "/"], unary_operators=["cos", "exp"]
+    )
+
+
+def _ctx(ops, L):
+    return MutationContext(
+        nops=ops.nops_tuple(),
+        nfeatures=3,
+        max_nodes=L,
+        perturbation_factor=0.076,
+        probability_negate_constant=0.01,
+    )
+
+
+def _random_population(key, n, ctx, min_size=1):
+    """[n] batch of random trees of assorted sizes (vmapped generator)."""
+    k_size, k_gen = jax.random.split(key)
+    sizes = jax.random.randint(k_size, (n,), min_size, ctx.max_nodes)
+    keys = jax.random.split(k_gen, n)
+    return jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(k, s, ctx, jnp.float32)
+    )(keys, sizes)
+
+
+def _mutate_population(key, trees, ctx):
+    """One round of every structural mutation, each applied to the whole
+    population. Kernels return (tree, ok); per their contract an
+    ``ok=False`` attempt's output is garbage and the generation step
+    discards it — mirror that by selecting the original tree there."""
+    budgets = branch_nu(ctx)
+    out = {}
+    k = key
+    for name, fn in (
+        ("mutate_constant",
+         lambda u, t: mutate_constant(u, t, jnp.float32(1.0), ctx)),
+        ("mutate_operator", lambda u, t: mutate_operator(u, t, ctx)),
+        ("swap_operands", lambda u, t: swap_operands(u, t, ctx)),
+        ("rotate_tree", lambda u, t: rotate_tree(u, t, ctx)),
+        ("add_node", lambda u, t: add_node(u, t, ctx)),
+        ("delete_node", lambda u, t: delete_node(u, t, ctx)),
+    ):
+        k, ku = jax.random.split(k)
+        n = trees.length.shape[0]
+        u = jax.random.uniform(ku, (n, budgets[name]))
+        mutated, ok = jax.vmap(lambda uu, t: fn(uu, t))(u, trees)
+        out[name] = jax.tree.map(
+            lambda new, old: jnp.where(
+                ok.reshape(ok.shape + (1,) * (new.ndim - 1)), new, old
+            ),
+            mutated, trees,
+        )
+    return out
+
+
+@pytest.mark.parametrize("seed,maxsize", [(0, 15), (1, 15), (2, 31), (3, 8)])
+def test_evolved_programs_satisfy_invariants(ops, seed, maxsize):
+    """1000+ programs per config: generation + a round of every
+    structural mutation + crossover all preserve the postfix invariants."""
+    ctx = _ctx(ops, maxsize)
+    key = jax.random.key(seed)
+    k_pop, k_mut, k_x = jax.random.split(key, 3)
+
+    P = 160
+    trees = _random_population(k_pop, P, ctx)
+    total = validate_programs(
+        trees, ops, nfeatures=3, n_params=0,
+        where=f"generated seed={seed} L={maxsize}",
+    )
+    assert total == P
+
+    checked = P
+    for name, mutated in _mutate_population(k_mut, trees, ctx).items():
+        checked += validate_programs(
+            mutated, ops, nfeatures=3, n_params=0,
+            where=f"{name} seed={seed} L={maxsize}",
+        )
+
+    # crossover: pair each tree with a rolled copy of the population
+    partner = jax.tree.map(lambda x: jnp.roll(x, 1, axis=0), trees)
+    u = jax.random.uniform(k_x, (P, 2 * ctx.max_nodes))
+    c1, c2, ok1, ok2 = jax.vmap(
+        lambda uu, a, b: crossover_trees(uu, a, b, ctx)
+    )(u, trees, partner)
+
+    def sel(new, old, ok):
+        return jax.tree.map(
+            lambda n_, o_: jnp.where(
+                ok.reshape(ok.shape + (1,) * (n_.ndim - 1)), n_, o_
+            ), new, old,
+        )
+
+    checked += validate_programs(
+        sel(c1, trees, ok1), ops, nfeatures=3, where="crossover-1")
+    checked += validate_programs(
+        sel(c2, partner, ok2), ops, nfeatures=3, where="crossover-2")
+
+    # the acceptance floor: >1000 programs validated per config
+    assert checked == 9 * P and checked >= 1000
+
+
+# ---------------------------------------------------------------------------
+# hand-corrupted tables must each be caught
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def clean_pop(ops):
+    ctx = _ctx(ops, 15)
+    return _random_population(jax.random.key(42), 32, ctx), ctx
+
+
+def _expect_violation(trees, ops, fragment, **kw):
+    msgs = check_programs(trees, ops, **kw)
+    assert msgs, "corruption not detected"
+    assert any(fragment in m for m in msgs), msgs
+    with pytest.raises(ProgramInvariantError):
+        validate_programs(trees, ops, **kw)
+
+
+def test_catches_stack_underflow(clean_pop, ops):
+    trees, _ = clean_pop
+    # arity-2 operator in slot 0 consumes operands that don't exist
+    bad = dataclasses.replace(
+        trees,
+        arity=trees.arity.at[:, 0].set(2),
+        length=jnp.maximum(trees.length, 2),
+    )
+    _expect_violation(bad, ops, "underflow")
+
+
+def test_catches_unrooted_forest(clean_pop, ops):
+    trees, ctx = clean_pop
+    # two stacked leaves with no operator: stack ends at height 2
+    bad = TreeBatch.empty((4,), ctx.max_nodes)
+    bad = dataclasses.replace(bad, length=jnp.full((4,), 2, jnp.int32))
+    _expect_violation(bad, ops, "unrooted")
+
+
+def test_catches_arity_out_of_range(clean_pop, ops):
+    trees, _ = clean_pop
+    bad = dataclasses.replace(trees, arity=trees.arity.at[:, 0].set(7))
+    msgs = check_programs(bad, ops)
+    assert any("arity outside" in m for m in msgs), msgs
+
+
+def test_catches_operator_index_out_of_range(clean_pop, ops):
+    trees, _ = clean_pop
+    # find a tree whose root is a binary op and corrupt its op index
+    arity = np.asarray(trees.arity)
+    length = np.asarray(trees.length)
+    roots = length - 1
+    cand = [
+        i for i in range(arity.shape[0]) if arity[i, roots[i]] == 2
+    ]
+    assert cand, "fixture needs at least one binary-rooted tree"
+    i = cand[0]
+    bad = dataclasses.replace(
+        trees, op=trees.op.at[i, int(roots[i])].set(99)
+    )
+    _expect_violation(bad, ops, "op index outside")
+
+
+def test_catches_bad_leaf_code(clean_pop, ops):
+    trees, _ = clean_pop
+    bad = dataclasses.replace(trees, op=trees.op.at[:, 0].set(11))
+    _expect_violation(bad, ops, "leaf op code")
+
+
+def test_catches_length_out_of_bounds(clean_pop, ops):
+    trees, ctx = clean_pop
+    bad = dataclasses.replace(
+        trees, length=trees.length.at[0].set(ctx.max_nodes + 5)
+    )
+    _expect_violation(bad, ops, "length")
+    bad0 = dataclasses.replace(trees, length=trees.length.at[0].set(0))
+    _expect_violation(bad0, ops, "length")
+
+
+def test_catches_dirty_padding_arity(clean_pop, ops):
+    trees, ctx = clean_pop
+    # an operator arity in a padding slot corrupts the full-axis
+    # structural prefix sums even though `length` excludes it
+    arity = np.asarray(trees.arity)
+    length = np.asarray(trees.length)
+    short = [i for i in range(arity.shape[0]) if length[i] <= ctx.max_nodes - 1]
+    assert short
+    i = short[0]
+    bad = dataclasses.replace(
+        trees, arity=trees.arity.at[i, ctx.max_nodes - 1].set(2)
+    )
+    _expect_violation(bad, ops, "padding")
+
+
+def test_catches_feature_out_of_range(clean_pop, ops):
+    trees, _ = clean_pop
+    # force a variable leaf with a feature index beyond nfeatures
+    bad = dataclasses.replace(
+        trees,
+        op=trees.op.at[:, 0].set(1),      # LEAF_VAR
+        arity=trees.arity.at[:, 0].set(0),
+        feat=trees.feat.at[:, 0].set(17),
+    )
+    msgs = check_programs(bad, ops, nfeatures=3)
+    assert any("feature outside" in m for m in msgs), msgs
+
+
+def test_strict_padding_mode(clean_pop, ops):
+    trees, ctx = clean_pop
+    canon = dataclasses.replace(
+        TreeBatch.empty(trees.batch_shape, ctx.max_nodes),
+        length=jnp.ones_like(trees.length),
+    )
+    assert check_programs(canon, ops, strict_padding=True) == []
+    dirty = dataclasses.replace(
+        canon, const=canon.const.at[:, ctx.max_nodes - 1].set(3.5)
+    )
+    msgs = check_programs(dirty, ops, strict_padding=True)
+    assert any("not zeroed" in m for m in msgs), msgs
+    # non-strict mode tolerates non-canonical payload padding
+    assert check_programs(dirty, ops) == []
+
+
+def test_clean_population_passes_all_optional_checks(clean_pop, ops):
+    trees, _ = clean_pop
+    assert check_programs(trees, ops, nfeatures=3, n_params=0) == []
+
+
+def test_device_predicate_agrees_with_host_checker(clean_pop, ops):
+    """ops.encoding.postfix_valid (jit-usable, structural subset) must
+    agree per-tree with the host checker on clean AND corrupted trees."""
+    trees, ctx = clean_pop
+    n = int(trees.length.shape[0])
+    # corrupt a scattering of trees in structurally different ways
+    bad = dataclasses.replace(
+        trees,
+        arity=trees.arity.at[0, 0].set(2)            # underflow at root
+        .at[3, ctx.max_nodes - 1].set(1),            # dirty padding arity
+        length=trees.length.at[5].set(0),            # length out of bounds
+    )
+    dev = np.asarray(jax.jit(postfix_valid)(bad.arity, bad.length))
+    for i in range(n):
+        host_msgs = check_programs(bad[i : i + 1], ops)
+        # the device predicate covers the structural subset; no op-code
+        # corruption is present here, so the verdicts must match exactly
+        assert bool(dev[i]) == (host_msgs == []), (i, host_msgs)
+    assert not dev[0] and not dev[3] and not dev[5]
+    assert dev.sum() >= n - 3

@@ -1,0 +1,545 @@
+"""graftlint rule fixtures: every rule must flag its hazard snippet and
+stay quiet on the matching clean snippet (false-positive regression
+suite), suppressions must work, and the real package tree must stay
+lint-clean (the property CI enforces).
+
+Pure AST tests — no JAX tracing happens here.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from symbolicregression_jl_tpu.lint import RULES, lint_paths, lint_source
+from symbolicregression_jl_tpu.lint.cli import main as lint_main
+
+
+def _lint(src: str, path: str = "pkg/evolve/mod.py"):
+    return lint_source(textwrap.dedent(src), path)
+
+
+def _ids(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# registry sanity
+# ---------------------------------------------------------------------------
+
+
+def test_registry_has_at_least_six_documented_rules():
+    assert len(RULES) >= 6
+    for rid, r in RULES.items():
+        assert rid == r.id
+        assert r.summary and r.rationale, f"{rid} missing catalog text"
+        assert r.name
+
+
+# ---------------------------------------------------------------------------
+# GL001 key-reuse
+# ---------------------------------------------------------------------------
+
+
+def test_gl001_flags_plain_reuse():
+    findings = _lint(
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.uniform(key, (3,))
+            b = jax.random.normal(key, (3,))
+            return a + b
+        """
+    )
+    assert "GL001" in _ids(findings)
+
+
+def test_gl001_flags_parent_key_used_after_split():
+    findings = _lint(
+        """
+        import jax
+
+        def f(key):
+            k1, k2 = jax.random.split(key)
+            leak = jax.random.uniform(key, (3,))
+            return leak + jax.random.uniform(k1, (3,)) + jax.random.normal(k2, ())
+        """
+    )
+    assert "GL001" in _ids(findings)
+
+
+def test_gl001_flags_reuse_across_loop_iterations():
+    findings = _lint(
+        """
+        import jax
+
+        def f(key, n):
+            out = 0.0
+            for i in range(n):
+                out = out + jax.random.uniform(key, ())
+            return out
+        """
+    )
+    assert "GL001" in _ids(findings)
+
+
+def test_gl001_clean_split_and_branches():
+    findings = _lint(
+        """
+        import jax
+
+        def f(key, flag):
+            k1, k2 = jax.random.split(key)
+            if flag:
+                a = jax.random.uniform(k1, (3,))
+            else:
+                a = jax.random.normal(k1, (3,))
+            return a + jax.random.uniform(k2, (3,))
+        """
+    )
+    assert "GL001" not in _ids(findings)
+
+
+def test_gl001_clean_fold_in_loop():
+    # fold_in(key, i) from one base key is the canonical stream-derivation
+    # idiom, not reuse.
+    findings = _lint(
+        """
+        import jax
+
+        def f(key, n):
+            out = 0.0
+            for i in range(n):
+                k = jax.random.fold_in(key, i)
+                out = out + jax.random.uniform(k, ())
+            return out
+        """
+    )
+    assert "GL001" not in _ids(findings)
+
+
+def test_gl001_stdlib_random_is_not_a_key():
+    # `import random` is the stdlib module: repeated first args are not
+    # PRNG keys (only `from jax import random` makes bare `random.` jax)
+    findings = _lint(
+        """
+        import random
+
+        def shuffle_twice(idx):
+            random.shuffle(idx)
+            return random.sample(idx, 3) + random.sample(idx, 2)
+        """,
+        path="pkg/api/util.py",
+    )
+    assert "GL001" not in _ids(findings)
+
+
+def test_gl001_from_jax_import_random_is_tracked():
+    findings = _lint(
+        """
+        from jax import random
+
+        def f(key):
+            a = random.uniform(key, (3,))
+            b = random.normal(key, (3,))
+            return a + b
+        """
+    )
+    assert "GL001" in _ids(findings)
+
+
+def test_gl001_clean_rebind_in_loop():
+    findings = _lint(
+        """
+        import jax
+
+        def f(key, n):
+            out = 0.0
+            for i in range(n):
+                key, k = jax.random.split(key)
+                out = out + jax.random.uniform(k, ())
+            return out
+        """
+    )
+    assert "GL001" not in _ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# GL002 host-rng (scoped to evolve/ and ops/ paths)
+# ---------------------------------------------------------------------------
+
+
+def test_gl002_flags_np_random_and_stdlib_random_in_evolve():
+    src = """
+    import random
+    import numpy as np
+
+    def noise(n):
+        return np.random.rand(n) + random.random()
+    """
+    findings = _lint(src, path="pkg/evolve/mutation.py")
+    gl002 = [f for f in findings if f.rule_id == "GL002"]
+    assert len(gl002) == 2
+
+
+def test_gl002_out_of_scope_path_is_clean():
+    src = """
+    import numpy as np
+
+    def seed_fallback():
+        return np.random.randint(0, 2**31 - 1)
+    """
+    assert "GL002" not in _ids(_lint(src, path="pkg/api/search.py"))
+
+
+def test_gl002_jax_random_is_clean():
+    src = """
+    import jax
+
+    def draw(key, n):
+        return jax.random.uniform(key, (n,))
+    """
+    assert "GL002" not in _ids(_lint(src, path="pkg/ops/eval.py"))
+
+
+# ---------------------------------------------------------------------------
+# GL003 traced-sync
+# ---------------------------------------------------------------------------
+
+
+def test_gl003_flags_float_cast_in_jit():
+    findings = _lint(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x.sum())
+        """
+    )
+    assert "GL003" in _ids(findings)
+
+
+def test_gl003_flags_item_in_scan_body():
+    findings = _lint(
+        """
+        import jax
+
+        def run(xs):
+            def step(c, x):
+                v = x.item()
+                return c + v, v
+            return jax.lax.scan(step, 0.0, xs)
+        """
+    )
+    assert "GL003" in _ids(findings)
+
+
+def test_gl003_flags_np_asarray_on_traced_value():
+    findings = _lint(
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x) * 2
+        """
+    )
+    assert "GL003" in _ids(findings)
+
+
+def test_gl003_clean_outside_trace_and_on_host_values():
+    findings = _lint(
+        """
+        import jax
+        import numpy as np
+
+        def host_driver(jitted, x):
+            return float(jitted(x).sum())
+
+        @jax.jit
+        def f(x):
+            pad = float("nan")
+            table = np.asarray([1.0, 2.0])
+            n = float(len(table))
+            return x + table[0] + pad + n
+        """
+    )
+    assert "GL003" not in _ids(findings)
+
+
+def test_gl003_propagates_through_local_calls():
+    # helper is only reachable from the jitted entry point
+    findings = _lint(
+        """
+        import jax
+
+        def helper(x):
+            return int(x[0])
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+        """
+    )
+    assert "GL003" in _ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# GL004 recompile-hazard
+# ---------------------------------------------------------------------------
+
+
+def test_gl004_flags_inline_jit_invocation():
+    findings = _lint(
+        """
+        import jax
+
+        def f(g, x):
+            return jax.jit(g)(x)
+        """
+    )
+    assert "GL004" in _ids(findings)
+
+
+def test_gl004_flags_jit_built_in_loop():
+    findings = _lint(
+        """
+        import jax
+
+        def f(xs):
+            out = []
+            for x in xs:
+                g = jax.jit(lambda v: v * 2)
+                out.append(g)
+            return out
+        """
+    )
+    assert "GL004" in _ids(findings)
+
+
+def test_gl004_flags_unhashable_static_arg():
+    findings = _lint(
+        """
+        import jax
+
+        def inner(x, cfg):
+            return x * cfg[0]
+
+        g = jax.jit(inner, static_argnums=(1,))
+
+        def run(x):
+            return g(x, [1, 2, 3])
+        """
+    )
+    assert "GL004" in _ids(findings)
+
+
+def test_gl004_clean_module_level_jit_and_hashable_statics():
+    findings = _lint(
+        """
+        import jax
+
+        def inner(x, cfg):
+            return x * cfg[0]
+
+        g = jax.jit(inner, static_argnums=(1,))
+
+        def run(x):
+            return g(x, (1, 2, 3))
+        """
+    )
+    assert "GL004" not in _ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# GL005 captured-mutation
+# ---------------------------------------------------------------------------
+
+
+def test_gl005_flags_closure_append_in_jit():
+    findings = _lint(
+        """
+        import jax
+
+        acc = []
+
+        @jax.jit
+        def f(x):
+            acc.append(x)
+            return x * 2
+        """
+    )
+    assert "GL005" in _ids(findings)
+
+
+def test_gl005_flags_subscript_store_on_parameter():
+    findings = _lint(
+        """
+        import jax
+
+        @jax.jit
+        def f(x, buf):
+            buf[0] = x
+            return x
+        """
+    )
+    assert "GL005" in _ids(findings)
+
+
+def test_gl005_clean_local_staging_and_library_calls():
+    findings = _lint(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            tmp = []
+            tmp.append(x * 2)
+            y = jax.lax.sort([x], dimension=0, num_keys=1)
+            return tmp[0] + y[0]
+        """
+    )
+    assert "GL005" not in _ids(findings)
+
+
+def test_gl005_clean_pallas_ref_stores():
+    findings = _lint(
+        """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2
+
+        def call(x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "GL005" not in _ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# GL006 stray-debug
+# ---------------------------------------------------------------------------
+
+
+def test_gl006_flags_bare_debug_print():
+    findings = _lint(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            jax.debug.print("x = {}", x)
+            return x * 2
+        """
+    )
+    assert "GL006" in _ids(findings)
+
+
+def test_gl006_clean_behind_debug_guard_or_debug_function():
+    findings = _lint(
+        """
+        import jax
+
+        DEBUG_CHECKS = False
+
+        @jax.jit
+        def f(x):
+            if DEBUG_CHECKS:
+                jax.debug.print("x = {}", x)
+            return x * 2
+
+        def debug_dump(x):
+            jax.debug.print("x = {}", x)
+        """
+    )
+    assert "GL006" not in _ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_per_line_suppression_single_rule():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return float(x.sum())  # graftlint: disable=GL003
+    """
+    assert _lint(src) == []
+
+
+def test_per_line_suppression_bare_disables_all():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return float(x.sum())  # graftlint: disable
+    """
+    assert _lint(src) == []
+
+
+def test_suppression_of_other_rule_does_not_hide_finding():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return float(x.sum())  # graftlint: disable=GL001
+    """
+    assert "GL003" in _ids(_lint(src))
+
+
+# ---------------------------------------------------------------------------
+# the real tree + CLI wiring
+# ---------------------------------------------------------------------------
+
+
+def _package_dir():
+    import symbolicregression_jl_tpu
+
+    return os.path.dirname(symbolicregression_jl_tpu.__file__)
+
+
+def test_package_tree_is_lint_clean():
+    """The property CI enforces: graftlint exits 0 on the real package."""
+    findings = lint_paths([_package_dir()])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert lint_main([_package_dir()]) == 0
+    bad = tmp_path / "evolve" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "import numpy as np\n\n"
+        "def f(n):\n"
+        "    return np.random.rand(n)\n"
+    )
+    assert lint_main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "GL002" in out
+
+
+def test_cli_survives_undecodable_and_null_byte_files(tmp_path, capsys):
+    (tmp_path / "latin.py").write_bytes(b"# caf\xe9\nx = 1\n")
+    (tmp_path / "nul.py").write_bytes(b"x = 1\x00\n")
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    assert lint_main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert out.count("GL000") == 2
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULES:
+        assert rid in out

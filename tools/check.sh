@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Repo check entry point: graftlint static analysis + fast-tier tests.
+# CI runs exactly this; run it locally before pushing.
+#
+#   tools/check.sh            # lint + fast tests
+#   tools/check.sh --lint     # lint only (fast, no JAX compile)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== graftlint: JAX-hazard static analysis =="
+python -m symbolicregression_jl_tpu.lint symbolicregression_jl_tpu/
+
+if [[ "${1:-}" == "--lint" ]]; then
+    exit 0
+fi
+
+echo "== fast-tier tests (pytest -m 'not slow') =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
