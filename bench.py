@@ -146,6 +146,12 @@ def main() -> None:
         "vs_baseline_legacy_1e4": round(
             rate / LEGACY_CPU_EVALS_PER_SEC, 3),
         "n_devices": n_dev,
+        # Candidate-eval path provenance (round 6): the in-kernel
+        # loss->cost epilogue state and launch geometry, so headline
+        # deltas across rounds attribute to the right knob.
+        "fuse_cost_epilogue": bool(engine.cfg.fuse_cost),
+        "eval_tree_block": engine.cfg.eval_tree_block,
+        "eval_tile_rows": engine.cfg.eval_tile_rows,
     }
     if n_dev == 1:
         # Projected v5e-8: measured single-chip rate x 8 devices x the

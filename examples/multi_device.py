@@ -16,8 +16,15 @@ Run:
 import os
 import sys
 
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
+# Append (don't setdefault): a pre-set XLA_FLAGS would otherwise swallow
+# the flag and the example silently runs on 1 device. XLA takes the last
+# occurrence of a repeated flag, so appending also wins over a
+# conflicting pre-set device count.
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", "").split():
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _FLAG
+    ).strip()
 
 import numpy as np  # noqa: E402
 

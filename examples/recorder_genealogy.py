@@ -53,17 +53,23 @@ def main(niterations: int = 3, seed: int = 0) -> None:
         print(f"  {k:24s} {c}")
 
     # walk one lineage: pick the last event and chase parents backwards
-    by_child = {ev["child"]: ev for ev in events}
-    ev = events[-1]
-    chain = []
-    while ev is not None and len(chain) < 10:
-        chain.append(ev)
-        ev = by_child.get(ev["parent"])
-    print("lineage of the last child (most recent first):")
-    for ev in chain:
-        print(f"  {ev['type']:20s} parent={ev['parent']} "
-              f"child={ev['child']} d_cost={ev['cost_delta']:+.3g}"
-              if isinstance(ev['cost_delta'], float) else ev)
+    # (a tiny run may accept nothing — print gracefully instead of
+    # raising on events[-1])
+    if events:
+        by_child = {ev["child"]: ev for ev in events}
+        ev = events[-1]
+        chain = []
+        while ev is not None and len(chain) < 10:
+            chain.append(ev)
+            ev = by_child.get(ev["parent"])
+        print("lineage of the last child (most recent first):")
+        for ev in chain:
+            print(f"  {ev['type']:20s} parent={ev['parent']} "
+                  f"child={ev['child']} d_cost={ev['cost_delta']:+.3g}"
+                  if isinstance(ev['cost_delta'], float) else ev)
+    else:
+        print("no accepted events in this run (try more iterations "
+              "or larger populations); skipping the lineage walk")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,224 @@
+"""Per-dispatch cost attribution of the evolve-cycle candidate-eval path.
+
+The round-6 analogue of opt_attrib.py (VERDICT item 7): the optimizer
+kernels run ~866k evals/s while the evolve cycle's candidate evals run
+~214k on the same chip — this script says where a cycle's time goes,
+dispatch by dispatch, and what the in-kernel cost epilogue
+(`ops.fused_eval.fused_cost`, options.fuse_cost_epilogue) removes.
+
+Three instruments:
+
+1. **HLO dispatch census** (backend-independent): compile the 1-cycle
+   evolve program with the cost epilogue ON vs OFF and count the
+   optimized module's instructions by opcode class. The scan body's
+   instruction list is the per-cycle dispatch sequence; the ON-OFF
+   delta is exactly the [T]-shaped mean/validity/normalization/
+   parsimony chain that the epilogue folds into the kernel's final
+   grid step.
+2. **Marginal cycle cost**: time the evolve chunk program at 1 and
+   1+K cycles; the slope is the per-cycle cost, free of per-launch
+   fixed overhead.
+3. **Eval-only launch**: time the candidate-eval dispatch alone on an
+   [islands, B + k2] batch replicating the generation step's launch
+   shape. machinery = marginal cycle - eval; the ratio is the honest
+   ceiling on any further eval-kernel work.
+
+Usage: python profiling/cycle_attrib.py [I] [P] [NC] [reps]
+  Bench config on TPU: 512 256 100. On CPU the fused path runs in
+  Pallas interpret mode — use small I/P; the census (instrument 1) is
+  backend-independent, the timings are CPU-relative only.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import sys
+import time
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from _common import make_bench_problem
+
+# Opcodes that lower to (roughly) one executable dispatch each; the
+# rest of the census is grouped to keep the table readable.
+_CLASSES = (
+    "fusion", "custom-call", "sort", "scatter", "gather", "while",
+    "reduce", "dot", "convert", "copy", "iota", "broadcast",
+)
+
+
+def _op_census(hlo_text: str) -> Counter:
+    """Instruction counts by opcode over an optimized HLO module."""
+    ops = Counter()
+    for m in re.finditer(r"=\s+\S+\s+([a-z][\w-]*)\(", hlo_text):
+        op = m.group(1)
+        ops[op if op in _CLASSES else "other"] += 1
+    return ops
+
+
+def _scan_body_census(hlo_text: str) -> Counter:
+    """Census restricted to the largest while-body computation — the
+    per-cycle dispatch sequence of the scanned generation step. (While
+    bodies are anonymous `%region_N` computations; they are resolved
+    through the `body=` operand of each `while` instruction.)"""
+    comps = {
+        m.group(1).lstrip("%"): m.group(2)
+        for m in re.finditer(
+            r"^(%?[\w.-]+)\s*\([^)]*\)\s*->[^{]*\{(.*?)^\}",
+            hlo_text, re.M | re.S)
+    }
+    best, best_n = Counter(), -1
+    for m in re.finditer(r"body=(%?[\w.-]+)", hlo_text):
+        c = _op_census(comps.get(m.group(1).lstrip("%"), ""))
+        n = sum(c.values())
+        if n > best_n:
+            best, best_n = c, n
+    return best
+
+
+def _eval_jaxpr_census(eval_fn, cand, data) -> Counter:
+    """Top-level jaxpr primitive census of one candidate-eval call —
+    the backend-independent dispatch list of the eval launch (the fused
+    kernel rides inside a single pjit eqn, so what this counts is
+    exactly the post-kernel epilogue chain plus the launch itself)."""
+    jaxpr = jax.make_jaxpr(eval_fn)(cand, data)
+    ops = Counter()
+    for eqn in jaxpr.jaxpr.eqns:
+        ops[eqn.primitive.name] += 1
+    return ops
+
+
+def _mk_engine(I, P, NC, fuse):
+    opts, ds, eng = make_bench_problem(
+        populations=I, population_size=P, ncycles_per_iteration=NC,
+        tournament_selection_n=16, turbo=True, fuse_cost_epilogue=fuse,
+    )
+    return opts, ds, eng
+
+
+def _chunk_args(eng, ds, state, maxsize):
+    cm, key, k_cycle, k_opt, k_mig, batch_idx, carry = eng._prelude_fn(
+        state.key, jnp.int32(maxsize), ds.data.y.shape[0],
+        state.birth.shape[0], state.pops.cost.dtype)
+    return (state.pops, state.birth, state.ref,
+            state.stats.normalized_frequencies, ds.data, cm, k_cycle,
+            batch_idx, jnp.int32(0), carry)
+
+
+def _time(fn, args, reps):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    I = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    P = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    NC = int(sys.argv[3]) if len(sys.argv) > 3 else 100
+    reps = int(sys.argv[4]) if len(sys.argv) > 4 else 3
+    K = 4  # extra cycles for the marginal-cost slope
+
+    from symbolicregression_jl_tpu import search_key
+    from symbolicregression_jl_tpu.evolve.step import eval_cost_batch
+
+    print(f"backend={jax.default_backend()}  I={I} P={P} NC={NC}")
+
+    runs = {}
+    for fuse in (True, False):
+        opts, ds, eng = _mk_engine(I, P, NC, fuse)
+        cfg = eng.cfg
+        state = eng.init_state(search_key(0), ds.data, I)
+        state = eng.run_iteration(state, ds.data, jnp.int32(opts.maxsize))
+        jax.block_until_ready(state.pops.cost)
+
+        # ---- 1. dispatch census of the 1-cycle program ----
+        args = _chunk_args(eng, ds, state, opts.maxsize)
+        fn1 = eng._chunk_fn(1, batching=args[7] is not None)
+        hlo = fn1.lower(*args).compile().as_text()
+        census = _op_census(hlo)
+        body = _scan_body_census(hlo)
+
+        # ---- 2. marginal per-cycle cost ----
+        fnK = eng._chunk_fn(1 + K, batching=args[7] is not None)
+        t1 = _time(fn1, args, reps)
+        tK = _time(fnK, args, reps)
+        t_cycle = (tK - t1) / K
+
+        # ---- 3. eval-only launch at the generation step's shape ----
+        B = cfg.n_slots
+        p_x = cfg.crossover_probability
+        if p_x <= 0.0:
+            k2 = 0
+        elif p_x >= 0.5:
+            k2 = B
+        else:
+            k2 = min(B, int(math.ceil(
+                B * p_x + 3.0 * math.sqrt(B * p_x * (1.0 - p_x)) + 1.0)))
+        nb = B + k2
+        cand = jax.tree.map(lambda x: x[:, :nb], state.pops.trees)
+
+        def eval_batch(trees, data):
+            return jax.vmap(lambda t: eval_cost_batch(
+                t, data, opts.elementwise_loss, eng.tables, cfg.operators,
+                cfg.parsimony, turbo=cfg.turbo, interpret=cfg.interpret,
+                tree_block=cfg.eval_tree_block,
+                tile_rows=cfg.eval_tile_rows, fuse_cost=cfg.fuse_cost,
+            ))(trees)
+
+        eval_fn = jax.jit(eval_batch)
+        t_eval = _time(eval_fn, (cand, ds.data), reps)
+        jx = _eval_jaxpr_census(eval_batch, cand, ds.data)
+
+        evals = I * nb
+        runs[fuse] = dict(census=census, body=body, jx=jx, t_cycle=t_cycle,
+                          t_eval=t_eval, evals=evals)
+        tag = "fused-cost" if fuse else "materializing"
+        print(f"\n== {tag} ==")
+        print(f"  1-cycle program census (module): "
+              f"{sum(census.values())} executable ops")
+        print("   ", dict(census.most_common()))
+        if body:
+            print(f"  scan-body (per-cycle dispatch sequence): "
+                  f"{sum(body.values())} ops")
+            print("   ", dict(body.most_common()))
+        print(f"  eval-launch jaxpr (kernel opaque as one pjit): "
+              f"{sum(jx.values())} primitives")
+        print("   ", dict(jx.most_common()))
+        print(f"  marginal cycle: {t_cycle * 1e3:8.2f} ms  "
+              f"({evals} candidate evals -> "
+              f"{evals / max(t_cycle, 1e-12):,.0f} evals/s)")
+        print(f"  eval-only launch: {t_eval * 1e3:8.2f} ms  "
+              f"({evals / max(t_eval, 1e-12):,.0f} evals/s)")
+        print(f"  machinery (cycle - eval): "
+              f"{(t_cycle - t_eval) * 1e3:8.2f} ms "
+              f"({100 * (t_cycle - t_eval) / max(t_cycle, 1e-12):.0f}% "
+              f"of the cycle)")
+
+    on, off = runs[True], runs[False]
+    d_mod = sum(off["census"].values()) - sum(on["census"].values())
+    d_body = sum(off["body"].values()) - sum(on["body"].values())
+    d_jx = sum(off["jx"].values()) - sum(on["jx"].values())
+    print("\n== epilogue fusion delta (materializing - fused) ==")
+    print(f"  eval-launch jaxpr primitives: {d_jx:+d} "
+          f"(the post-kernel loss->cost chain)")
+    print(f"  module ops: {d_mod:+d}   scan-body ops/cycle: {d_body:+d}")
+    print(f"  marginal cycle: {(off['t_cycle'] - on['t_cycle']) * 1e3:+.2f} ms"
+          f"   eval launch: {(off['t_eval'] - on['t_eval']) * 1e3:+.2f} ms")
+    if jax.default_backend() != "tpu":
+        print("\n(note: off-TPU the fused kernel runs in Pallas interpret "
+              "mode — HLO/kernel-side counts and all timings are "
+              "CPU-relative; the jaxpr delta is backend-independent.)")
+
+
+if __name__ == "__main__":
+    main()

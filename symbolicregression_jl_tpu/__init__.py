@@ -48,6 +48,10 @@ __all__ = [
     "string_tree",
     "LOSS_REGISTRY",
     "resolve_loss",
+    # lazily exposed via __getattr__ (api.search) — listed so
+    # star-imports and IDE completion see them:
+    "equation_search",
+    "warmup",
 ]
 
 

@@ -241,9 +241,14 @@ def _seed_population(
         # random-fallback-with-warning for invalid seed populations
         # (src/SymbolicRegression.jl:835-857) — a bad seed must not
         # abort the search.
+        # Filter oversized seeds FIRST, then truncate to the islands x
+        # population_size capacity — a rejected seed early in the list
+        # must not push a valid one past the cutoff.
         kept, kept_params = [], []
         ps = list(params) if params is not None else None
-        for i, t in enumerate(list(trees)[: I * P]):
+        for i, t in enumerate(trees):
+            if len(kept) >= I * P:
+                break
             n = t.count_nodes()
             if n > cfg.max_nodes:
                 import warnings

@@ -117,6 +117,17 @@ def aggregate_loss(
     return jnp.where(jnp.isnan(loss), inf, loss)
 
 
+def baseline_normalization(baseline_loss, use_baseline, dtype):
+    """max(baseline, 0.01) with the 0.01 floor when the baseline is
+    unusable (/root/reference/src/LossFunctions.jl:170-190). Shared by
+    `loss_to_cost` and the fused kernel's in-kernel cost epilogue
+    (ops.fused_eval.fused_cost_program) so the two paths cannot drift."""
+    return jnp.where(
+        use_baseline & (baseline_loss >= 0.01), baseline_loss,
+        jnp.asarray(0.01, dtype=dtype)
+    )
+
+
 def loss_to_cost(
     loss,
     baseline_loss,
@@ -129,7 +140,6 @@ def loss_to_cost(
     Mirrors /root/reference/src/LossFunctions.jl:170-190 (normalization
     floor of 0.01 when the baseline is unusable).
     """
-    normalization = jnp.where(
-        use_baseline & (baseline_loss >= 0.01), baseline_loss, jnp.asarray(0.01, dtype=loss.dtype)
-    )
+    normalization = baseline_normalization(baseline_loss, use_baseline,
+                                           loss.dtype)
     return loss / normalization + parsimony * complexity.astype(loss.dtype)

@@ -317,6 +317,18 @@ class Options:
         batching: Optional[bool] = None,
         batch_size: Optional[int] = None,
         turbo: Optional[bool] = None,  # None = auto: fused Pallas kernel on TPU
+        # Candidate-eval kernel launch geometry (the fused Pallas path):
+        # trees per kernel block / row-tile cap. None = kernel defaults
+        # (8 / 16384). The per-island tree_block knob from the round-6
+        # cycle attribution (profiling/cycle_attrib.py).
+        eval_tree_block: Optional[int] = None,
+        eval_tile_rows: Optional[int] = None,
+        # Fuse the loss->cost epilogue (mean, validity->inf, baseline
+        # normalization, parsimony penalty) into the candidate-eval
+        # kernel's final grid step. None = auto: on whenever turbo is
+        # on; False keeps the materializing post-kernel arithmetic
+        # (A/B profiling — profiling/cycle_attrib.py).
+        fuse_cost_epilogue: Optional[bool] = None,
         bumper: bool = False,  # accepted for API parity (no allocator to tune)
         autodiff_backend=None,  # ignored: gradients always via jax.grad
         # 12. Determinism
@@ -494,6 +506,13 @@ class Options:
         self.batching = bool(batching if batching is not None else d["batching"])
         self.batch_size = int(batch_size if batch_size is not None else d["batch_size"])
         self.turbo = turbo  # tri-state: None=auto / True / False
+        self.eval_tree_block = (
+            None if eval_tree_block is None else int(eval_tree_block)
+        )
+        self.eval_tile_rows = (
+            None if eval_tile_rows is None else int(eval_tile_rows)
+        )
+        self.fuse_cost_epilogue = fuse_cost_epilogue  # tri-state
         self.bumper = bool(bumper)
         self.autodiff_backend = autodiff_backend
 
@@ -521,6 +540,10 @@ class Options:
             raise ValueError(
                 "tournament_selection_n must be less than population_size"
             )
+        if self.eval_tree_block is not None and self.eval_tree_block <= 0:
+            raise ValueError("eval_tree_block must be positive")
+        if self.eval_tile_rows is not None and self.eval_tile_rows <= 0:
+            raise ValueError("eval_tile_rows must be positive")
 
     @property
     def nops(self):

@@ -128,8 +128,9 @@ class Engine:
             # Without the mesh the island-local phases cannot be
             # shard_map'ed and the Pallas kernels would hit GSPMD with
             # no partitioning rule — fall back to the jnp interpreter,
-            # which partitions cleanly.
-            self.cfg = self.cfg._replace(turbo=False)
+            # which partitions cleanly. (The cost epilogue lives in the
+            # fused kernel, so it goes with it.)
+            self.cfg = self.cfg._replace(turbo=False, fuse_cost=False)
         self._shard_islands = (
             self.cfg.turbo and n_island_shards > 1 and mesh is not None
         )
@@ -158,6 +159,9 @@ class Engine:
                 dim_penalty=self.cfg.dim_penalty,
                 wildcard_constants=self.cfg.wildcard_constants,
                 template=self.cfg.template,
+                tree_block=self.cfg.eval_tree_block,
+                tile_rows=self.cfg.eval_tile_rows,
+                fuse_cost=self.cfg.fuse_cost,
             )
 
         self._eval_cost = jax.jit(eval_cost_flat)
@@ -242,6 +246,9 @@ class Engine:
                 dim_penalty=cfg.dim_penalty,
                 wildcard_constants=cfg.wildcard_constants,
                 template=cfg.template,
+                tree_block=cfg.eval_tree_block,
+                tile_rows=cfg.eval_tile_rows,
+                fuse_cost=cfg.fuse_cost,
             )
         )(trees, params)
 
@@ -687,6 +694,8 @@ class Engine:
                 dim_penalty=cfg.dim_penalty,
                 wildcard_constants=cfg.wildcard_constants,
                 template=cfg.template, dedup=True,
+                tree_block=cfg.eval_tree_block,
+                tile_rows=cfg.eval_tile_rows,
             )
             cost, loss, cx = (cost.reshape(I, P), loss.reshape(I, P),
                               cx.reshape(I, P))
@@ -700,6 +709,9 @@ class Engine:
                     dim_penalty=cfg.dim_penalty,
                     wildcard_constants=cfg.wildcard_constants,
                     template=cfg.template,
+                    tree_block=cfg.eval_tree_block,
+                    tile_rows=cfg.eval_tile_rows,
+                    fuse_cost=cfg.fuse_cost,
                 )
             )(pops.trees, pops.params)
         return dataclasses.replace(pops, cost=cost, loss=loss,

@@ -33,7 +33,7 @@ from ..ops.complexity import (
 )
 from ..ops.encoding import LEAF_CONST, TreeBatch, tree_structure_arrays
 from ..ops.eval import eval_tree_batch
-from ..ops.fused_eval import fused_loss, supports_fused_eval
+from ..ops.fused_eval import fused_cost, fused_loss, supports_fused_eval
 from ..ops.operators import OperatorSet
 from . import mutation as M
 from .population import PopulationState
@@ -131,6 +131,12 @@ class EvolveConfig(NamedTuple):
     # mutation batch for static lowering choices (see mctx); 0 = unknown
     # (ad-hoc EvolveConfig constructions), treated as large.
     n_islands: int = 0
+    # Candidate-eval kernel tuning (options.eval_tree_block /
+    # eval_tile_rows; kernel defaults when unset) and the in-kernel
+    # loss->cost epilogue gate (round 6, profiling/cycle_attrib.py).
+    eval_tree_block: int = 8
+    eval_tile_rows: int = 16384
+    fuse_cost: bool = False
 
     @property
     def n_slots(self) -> int:
@@ -224,6 +230,14 @@ def evolve_config_from_options(options: Options, nfeatures: int,
         template=template,
         record_events=bool(getattr(options, "use_recorder", False)),
         n_islands=max(1, options.populations // max(n_island_shards, 1)),
+        eval_tree_block=getattr(options, "eval_tree_block", None) or 8,
+        eval_tile_rows=getattr(options, "eval_tile_rows", None) or 16384,
+        # In-kernel loss->cost epilogue: auto-on with turbo (the fused
+        # kernel is the only place the epilogue can live); tri-state
+        # override for A/B measurement.
+        fuse_cost=turbo and (
+            getattr(options, "fuse_cost_epilogue", None) is not False
+        ),
     )
 
 
@@ -415,7 +429,8 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
                     operators, parsimony, batch_idx=None, member_params=None,
                     turbo=False, interpret=False, loss_function=None,
                     dim_penalty=1000.0, wildcard_constants=True,
-                    template=None, dedup=False):
+                    template=None, dedup=False, tree_block=None,
+                    tile_rows=None, fuse_cost=False):
     """Batched eval_cost (src/LossFunctions.jl:193-209): (cost, loss, complexity).
 
     ``turbo`` routes through the fused Pallas eval+loss kernel (the hot
@@ -424,6 +439,15 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
     [..., n_params, n_classes], expanded to per-row values via the
     dataset's class column (eval_tree_dispatch for ParametricExpression,
     /root/reference/src/ParametricExpression.jl:88-100).
+
+    ``fuse_cost`` additionally fuses the loss->cost epilogue (mean,
+    validity, baseline normalization, parsimony penalty) into the
+    kernel's final grid step (ops.fused_eval.fused_cost) — bit-identical
+    results, fewer per-cycle dispatches. Plain elementwise-loss
+    expressions only; custom-loss / template / parametric / dedup
+    callers keep the materializing epilogue, gated exactly like turbo.
+    ``tree_block`` / ``tile_rows`` override the fused kernel's launch
+    geometry (options.eval_tree_block / eval_tile_rows).
     """
     if batch_idx is None:
         X = data.Xt
@@ -480,7 +504,24 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
         raise ValueError(
             "Parametric evaluation requires a `class` column in the dataset"
         )
-    if turbo and loss_function is None:
+    tb = tree_block if tree_block is not None else 8
+    tr = tile_rows if tile_rows is not None else 16384
+    fused_cost_path = (
+        turbo and fuse_cost and loss_function is None and not has_params
+        and not dedup
+    )
+    if fused_cost_path:
+        # Hot path of the evolve cycle: complexity feeds the kernel's
+        # cost epilogue, and (cost, loss) come back final — no
+        # post-kernel [T]-shaped dispatches.
+        complexity = compute_complexity_batch(trees, tables)
+        cost, loss, _valid = fused_cost(
+            trees, X, y, w, complexity, operators, elementwise_loss,
+            baseline_loss=data.baseline_loss,
+            use_baseline=data.use_baseline, parsimony=parsimony,
+            tree_block=tb, tile_rows=tr, interpret=interpret,
+        )
+    elif turbo and loss_function is None:
         # Parametric members ride the fused kernel too: their banks
         # materialize as per-row buffer region values inside the kernel
         # (class one-hot contraction), no [T, NP, n] HBM buffers.
@@ -488,6 +529,7 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
             trees, X, y, w, operators, elementwise_loss,
             params=member_params if has_params else None,
             class_idx=class_idx if has_params else None,
+            tree_block=tb, tile_rows=tr,
             interpret=interpret, dedup=dedup,
         )
     else:
@@ -497,9 +539,10 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
         )
         pred, valid = eval_tree_batch(trees, X, operators, params=params)
         loss = _loss_from_pred(pred, valid)
-    complexity = compute_complexity_batch(trees, tables)
-    cost = loss_to_cost(loss, data.baseline_loss, data.use_baseline, complexity,
-                        parsimony)
+    if not fused_cost_path:
+        complexity = compute_complexity_batch(trees, tables)
+        cost = loss_to_cost(loss, data.baseline_loss, data.use_baseline,
+                            complexity, parsimony)
     if data.x_dims is not None and dim_penalty is not None:
         # Single-sample dimensional check on the full dataset's first row
         # (src/DimensionalAnalysis.jl:223-257); violations add a flat cost
@@ -798,6 +841,8 @@ def generation_step(
             dim_penalty=cfg.dim_penalty,
             wildcard_constants=cfg.wildcard_constants,
             template=cfg.template,
+            tree_block=cfg.eval_tree_block, tile_rows=cfg.eval_tile_rows,
+            fuse_cost=cfg.fuse_cost,
         )
 
     if 0 < k2 < B:

@@ -36,12 +36,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..core.losses import baseline_normalization
 from .encoding import TreeBatch
 from .operators import OperatorSet
 from .program import TreeProgram, compile_program
 
 __all__ = ["fused_loss", "fused_loss_program", "fused_loss_multi",
-           "fused_loss_dedup",
+           "fused_loss_dedup", "fused_cost", "fused_cost_program",
            "fused_grad_program", "fused_grad_multi",
            "fused_loss_and_const_grad", "fused_predict",
            "fused_predict_program", "fused_predict_ad",
@@ -360,21 +361,35 @@ def _make_program_kernel(
     cmax: int,
     nparam: int = 0,
     nclass: int = 0,
+    cost_epilogue: bool = False,
 ):
     CBASE = nfeat + nparam
     BASE = CBASE + cmax
 
     def kernel(*refs):
+        i = 4
+        instr_ref, nstep_ref, cvals_ref, ok_ref = refs[:4]
         if nparam > 0:
-            (instr_ref, nstep_ref, cvals_ref, ok_ref,
-             pbank_ref,  # SMEM [TB, NP * NC] f32 — per-tree param banks
-             x_ref, clsoh_ref,  # VMEM [NC, TILE] f32 class one-hots
-             y_ref, w_ref, mask_ref,
-             loss_ref, valid_ref, buf_ref) = refs
-        else:
-            (instr_ref, nstep_ref, cvals_ref, ok_ref,
-             x_ref, y_ref, w_ref, mask_ref,
-             loss_ref, valid_ref, buf_ref) = refs
+            pbank_ref = refs[i]  # SMEM [TB, NP * NC] f32 param banks
+            i += 1
+        x_ref = refs[i]
+        i += 1
+        if nparam > 0:
+            clsoh_ref = refs[i]  # VMEM [NC, TILE] f32 class one-hots
+            i += 1
+        y_ref, w_ref, mask_ref = refs[i:i + 3]
+        i += 3
+        if cost_epilogue:
+            # SMEM: per-tree complexity (as the buffer dtype) and the
+            # [denom, normalization, parsimony] scalar triple.
+            cx_ref, scal_ref = refs[i:i + 2]
+            i += 2
+        loss_ref, valid_ref = refs[i:i + 2]
+        i += 2
+        if cost_epilogue:
+            cost_ref = refs[i]
+            i += 1
+        buf_ref = refs[i]
         j = pl.program_id(1)
         y_row = y_ref[0, :]
         mask_row = mask_ref[0, :] > 0
@@ -439,17 +454,31 @@ def _make_program_kernel(
                 loss_ref[t, 0] = loss_ref[t, 0] + partial
                 valid_ref[t, 0] = valid_ref[t, 0] & partial_ok
 
+            if cost_epilogue:
+                # Cost epilogue, run once per tree on the LAST row tile
+                # (grid dim 1 iterates innermost, so the accumulators
+                # above are complete): finalize the mean, apply the
+                # invalid => inf contract, and emit
+                # cost = loss / normalization + parsimony * complexity
+                # (core.losses.loss_to_cost, same op order for bit
+                # parity) — the [T]-shaped XLA dispatch chain that
+                # otherwise runs per evolve cycle disappears into the
+                # kernel's scalar core.
+                @pl.when(j == pl.num_programs(1) - 1)
+                def _():
+                    ok = valid_ref[t, 0] > 0
+                    mean = loss_ref[t, 0] / scal_ref[0, 0]
+                    lossf = jnp.where(
+                        ok & jnp.isfinite(mean), mean,
+                        jnp.asarray(jnp.inf, mean.dtype))
+                    loss_ref[t, 0] = lossf
+                    cost_ref[t, 0] = (lossf / scal_ref[0, 1]
+                                      + scal_ref[0, 2] * cx_ref[t, 0])
+
     return kernel
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "nfeatures", "operators", "loss_fn", "tree_block", "tile_rows",
-        "interpret",
-    ),
-)
-def fused_loss_program(
+def _program_launch(
     prog: TreeProgram,          # flat [T, L] program
     X: jax.Array,               # [F, n]
     y: jax.Array,               # [n]
@@ -457,17 +486,16 @@ def fused_loss_program(
     nfeatures: int,
     operators: OperatorSet,
     loss_fn: Callable,
-    *,
-    params: Optional[jax.Array] = None,     # [T, NP, NC] member banks
-    class_oh: Optional[jax.Array] = None,   # [NC, n] class one-hots
-    tree_block: int = 16,
-    tile_rows: int = 16384,
-    interpret: bool = False,
-) -> Tuple[jax.Array, jax.Array]:
-    """Mean elementwise loss per compiled tree program (flat [T]).
-
-    Parametric trees pass per-member banks + class one-hot rows; the
-    program must have been compiled with the matching ``n_params``."""
+    params: Optional[jax.Array],     # [T, NP, NC] member banks
+    class_oh: Optional[jax.Array],   # [NC, n] class one-hots
+    complexity: Optional[jax.Array],  # [T] — enables the cost epilogue
+    cost_scal: Optional[jax.Array],   # [1, 3] (denom, norm, parsimony)
+    tree_block: int,
+    tile_rows: int,
+    interpret: bool,
+):
+    """Shared single-variant launch: the loss path (complexity=None)
+    returns (loss, valid); the cost-epilogue path also returns cost."""
     T, L = prog.code.shape
     CMAX = prog.cmax
     F, n = X.shape
@@ -501,8 +529,9 @@ def fused_loss_program(
     maskp = jnp.pad(jnp.ones((1, n), dtype), ((0, 0), (0, n_pad - n)))
 
     grid = (T_pad // TB, n_pad // TILE)
+    fuse_cost = complexity is not None
     kernel = _make_program_kernel(operators, loss_fn, TB, nfeatures, CMAX,
-                                  NP, NC)
+                                  NP, NC, cost_epilogue=fuse_cost)
 
     smem_i32 = lambda shape: pl.BlockSpec(
         shape, lambda i, j: (i, 0), memory_space=pltpu.SMEM
@@ -529,31 +558,127 @@ def fused_loss_program(
             jnp.pad(class_oh.astype(dtype), ((0, 0), (0, n_pad - n))))
     in_specs += [row_spec, row_spec, row_spec]   # y, w, mask
     operands += [yp, wp, maskp]
+    if fuse_cost:
+        in_specs.append(pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                                     memory_space=pltpu.SMEM))  # complexity
+        operands.append(pad_t(complexity.reshape(-1, 1).astype(dtype)))
+        in_specs.append(pl.BlockSpec((1, 3), lambda i, j: (0, 0),
+                                     memory_space=pltpu.SMEM))  # scalars
+        operands.append(cost_scal.astype(dtype))
 
-    loss_sum, valid = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                     memory_space=pltpu.SMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((T_pad, 1), dtype),
+        jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
+    ]
+    if fuse_cost:
+        out_specs.append(pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
+                                      memory_space=pltpu.SMEM))
+        out_shape.append(jax.ShapeDtypeStruct((T_pad, 1), dtype))
+
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((TB, 1), lambda i, j: (i, 0),
-                         memory_space=pltpu.SMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T_pad, 1), dtype),
-            jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((BASE + L + ZR, TILE), dtype)],
         interpret=interpret,
     )(*operands)
 
-    loss_sum = loss_sum[:T, 0]
-    valid = valid[:T, 0].astype(jnp.bool_)
+    valid = out[1][:T, 0].astype(jnp.bool_)
+    if fuse_cost:
+        # loss/cost were finalized in-kernel (mean + invalid => inf).
+        return out[2][:T, 0], out[0][:T, 0], valid
+    loss_sum = out[0][:T, 0]
     denom = jnp.sum(w) if weights is not None else jnp.asarray(n, dtype)
     loss = loss_sum / denom
     loss = jnp.where(valid & jnp.isfinite(loss), loss, jnp.inf)
     return loss, valid
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "nfeatures", "operators", "loss_fn", "tree_block", "tile_rows",
+        "interpret",
+    ),
+)
+def fused_loss_program(
+    prog: TreeProgram,          # flat [T, L] program
+    X: jax.Array,               # [F, n]
+    y: jax.Array,               # [n]
+    weights: Optional[jax.Array],
+    nfeatures: int,
+    operators: OperatorSet,
+    loss_fn: Callable,
+    *,
+    params: Optional[jax.Array] = None,     # [T, NP, NC] member banks
+    class_oh: Optional[jax.Array] = None,   # [NC, n] class one-hots
+    tree_block: int = 16,
+    tile_rows: int = 16384,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Mean elementwise loss per compiled tree program (flat [T]).
+
+    Parametric trees pass per-member banks + class one-hot rows; the
+    program must have been compiled with the matching ``n_params``."""
+    return _program_launch(
+        prog, X, y, weights, nfeatures, operators, loss_fn, params,
+        class_oh, None, None, tree_block, tile_rows, interpret)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "nfeatures", "operators", "loss_fn", "tree_block", "tile_rows",
+        "interpret",
+    ),
+)
+def fused_cost_program(
+    prog: TreeProgram,          # flat [T, L] program
+    X: jax.Array,               # [F, n]
+    y: jax.Array,               # [n]
+    weights: Optional[jax.Array],
+    complexity: jax.Array,      # [T] int32 per-tree complexity
+    nfeatures: int,
+    operators: OperatorSet,
+    loss_fn: Callable,
+    *,
+    baseline_loss: jax.Array,   # scalar (dataset baseline)
+    use_baseline: jax.Array,    # bool scalar
+    parsimony,                  # float (or scalar array)
+    tree_block: int = 16,
+    tile_rows: int = 16384,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(cost, loss, valid) per compiled program, cost fused in-kernel.
+
+    The cost epilogue replicates ``core.losses.loss_to_cost`` (baseline
+    normalization with the 0.01 floor + the parsimony complexity
+    penalty) on the kernel's final row tile, so candidate evaluation
+    emits (T,)-shaped cost/loss with no post-kernel XLA dispatches.
+    Non-parametric programs only (the parametric const_ok fixup needs
+    the loss before the inf mapping)."""
+    dtype = X.dtype
+    n = X.shape[1]
+    # Same reshape/astype-then-sum as the loss path's denominator so the
+    # two paths stay bit-identical.
+    denom = (jnp.sum(weights.reshape(1, n).astype(dtype))
+             if weights is not None else jnp.asarray(n, dtype))
+    norm = baseline_normalization(baseline_loss, use_baseline, dtype)
+    scal = jnp.stack([
+        denom.astype(dtype), norm.astype(dtype),
+        jnp.asarray(parsimony, dtype),
+    ]).reshape(1, 3)
+    return _program_launch(
+        prog, X, y, weights, nfeatures, operators, loss_fn, None, None,
+        complexity, scal, tree_block, tile_rows, interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -1307,6 +1432,54 @@ def fused_loss(
     if batch_shape:
         return loss.reshape(batch_shape), valid.reshape(batch_shape)
     return loss[0], valid[0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "operators", "loss_fn", "tree_block", "tile_rows", "interpret",
+    ),
+)
+def fused_cost(
+    trees: TreeBatch,
+    X: jax.Array,               # [F, n]
+    y: jax.Array,               # [n]
+    weights: Optional[jax.Array],  # [n] or None
+    complexity: jax.Array,      # [...] int32, the TreeBatch's batch dims
+    operators: OperatorSet,
+    loss_fn: Callable,
+    *,
+    baseline_loss: jax.Array,
+    use_baseline: jax.Array,
+    parsimony,
+    tree_block: int = 8,
+    tile_rows: int = 16384,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(cost, loss, valid) per tree with the loss→cost epilogue fused
+    into the eval kernel (see `fused_cost_program`).
+
+    The candidate-eval hot path of the evolve cycle: one kernel launch
+    returns final (programs,)-shaped costs — the per-cycle [T]-shaped
+    mean/validity/normalization/parsimony dispatch chain of the
+    materializing path collapses into the kernel's last grid step.
+    Plain (non-parametric, non-template) expressions only; callers gate
+    exactly like the turbo gate (evolve.step.eval_cost_batch).
+    """
+    batch_shape = trees.batch_shape
+    flat = trees.reshape(-1) if batch_shape else trees.reshape(1)
+    F = X.shape[0]
+    prog = compile_program(flat, F, len(operators.binary))
+    cost, loss, valid = fused_cost_program(
+        prog, X, y, weights, complexity.reshape(-1), F, operators, loss_fn,
+        baseline_loss=baseline_loss, use_baseline=use_baseline,
+        parsimony=parsimony, tree_block=tree_block, tile_rows=tile_rows,
+        interpret=interpret,
+    )
+    if batch_shape:
+        return (cost.reshape(batch_shape), loss.reshape(batch_shape),
+                valid.reshape(batch_shape))
+    return cost[0], loss[0], valid[0]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,260 @@
+"""In-kernel cost epilogue (ops.fused_eval.fused_cost) parity tests.
+
+The round-6 hot path returns (cost, loss, valid) straight from the
+candidate-eval kernel's final grid step. The contract: BIT-identical to
+the materializing path (fused_loss + loss_to_cost outside the kernel),
+fp-tolerance agreement with the jnp interpreter, and unchanged
+NaN/invalid => inf semantics — at the kernel, eval_cost_batch, and
+whole-engine levels.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import symbolicregression_jl_tpu as sr
+from symbolicregression_jl_tpu.core.losses import (
+    aggregate_loss,
+    l2_dist_loss,
+    loss_to_cost,
+)
+from symbolicregression_jl_tpu.evolve.population import init_population
+from symbolicregression_jl_tpu.evolve.step import (
+    eval_cost_batch,
+    evolve_config_from_options,
+)
+from symbolicregression_jl_tpu.ops.complexity import (
+    build_complexity_tables,
+    compute_complexity_batch,
+)
+from symbolicregression_jl_tpu.ops.encoding import encode_population
+from symbolicregression_jl_tpu.ops.eval import eval_tree_batch
+from symbolicregression_jl_tpu.ops.fused_eval import fused_cost, fused_loss
+
+
+@pytest.fixture(scope="module")
+def setup():
+    opts = sr.Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "abs", "exp"],
+        maxsize=20,
+        save_to_file=False,
+    )
+    cfg = evolve_config_from_options(opts, 3)
+    tables = build_complexity_tables(opts, 3)
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.uniform(-3, 3, (3, 257)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=257).astype(np.float32))
+    return opts, cfg, tables, X, y
+
+
+BASELINE = dict(baseline_loss=jnp.float32(1.7), use_baseline=jnp.bool_(True),
+                parsimony=0.0032)
+
+
+def test_fused_cost_bit_equal_to_materializing_path(setup):
+    """cost/loss from the epilogue == fused_loss + loss_to_cost, to the
+    bit (same kernel partials, same op order for the epilogue math)."""
+    opts, cfg, tables, X, y = setup
+    trees = init_population(jax.random.PRNGKey(3), 24, cfg.mctx, jnp.float32)
+    cx = compute_complexity_batch(trees, tables)
+    l_ref, v_ref = fused_loss(
+        trees, X, y, None, cfg.operators, l2_dist_loss, interpret=True)
+    c_ref = loss_to_cost(l_ref, BASELINE["baseline_loss"],
+                         BASELINE["use_baseline"], cx, BASELINE["parsimony"])
+    c, l, v = fused_cost(
+        trees, X, y, None, cx, cfg.operators, l2_dist_loss,
+        interpret=True, **BASELINE)
+    assert np.array_equal(np.asarray(v), np.asarray(v_ref))
+    assert np.array_equal(np.asarray(l), np.asarray(l_ref))
+    assert np.array_equal(np.asarray(c), np.asarray(c_ref))
+
+
+def test_fused_cost_matches_interpreter_with_invalids(setup):
+    """Agreement with the jnp interpreter incl. the invalid => inf
+    contract (1/0 domain failure) and leaf-only trees."""
+    opts, cfg, tables, X, y = setup
+    opset = cfg.operators
+    exprs = [
+        sr.parse_expression("cos(2.13 * x1) + 0.5 * x2", opset),
+        sr.parse_expression("x1 * x2 - exp(x3 / 2.0)", opset),
+        sr.parse_expression("abs(x3) / (x1 - x1)", opset),  # 1/0 -> invalid
+        sr.parse_expression("1.5", opset),
+        sr.parse_expression("x1", opset),
+    ]
+    batch = encode_population(exprs, opts.maxsize, opset)
+    cx = compute_complexity_batch(batch, tables)
+    pred, v_ref = eval_tree_batch(batch, X, opset)
+    l_ref = aggregate_loss(l2_dist_loss, pred, y, v_ref)
+    c_ref = loss_to_cost(l_ref, BASELINE["baseline_loss"],
+                         BASELINE["use_baseline"], cx, BASELINE["parsimony"])
+    c, l, v = fused_cost(
+        batch, X, y, None, cx, opset, l2_dist_loss, interpret=True,
+        **BASELINE)
+    assert np.array_equal(np.asarray(v), np.asarray(v_ref))
+    ok = np.isfinite(np.asarray(l_ref))
+    assert np.allclose(np.asarray(l)[ok], np.asarray(l_ref)[ok], rtol=1e-5)
+    assert np.all(np.isinf(np.asarray(l)[~ok]))
+    assert np.allclose(np.asarray(c)[ok], np.asarray(c_ref)[ok], rtol=1e-5)
+    assert np.all(np.isinf(np.asarray(c)[~ok]))
+
+
+@pytest.mark.slow
+def test_fused_cost_weighted(setup):
+    opts, cfg, tables, X, y = setup
+    n = X.shape[1]
+    w = jnp.asarray(
+        np.random.default_rng(1).uniform(0.5, 2.0, n).astype(np.float32))
+    trees = init_population(jax.random.PRNGKey(9), 8, cfg.mctx, jnp.float32)
+    cx = compute_complexity_batch(trees, tables)
+    l_ref, _ = fused_loss(
+        trees, X, y, w, cfg.operators, l2_dist_loss, interpret=True)
+    c_ref = loss_to_cost(l_ref, BASELINE["baseline_loss"],
+                         BASELINE["use_baseline"], cx, BASELINE["parsimony"])
+    c, l, _ = fused_cost(
+        trees, X, y, w, cx, cfg.operators, l2_dist_loss, interpret=True,
+        **BASELINE)
+    assert np.array_equal(np.asarray(l), np.asarray(l_ref))
+    assert np.array_equal(np.asarray(c), np.asarray(c_ref))
+
+
+@pytest.mark.slow
+def test_fused_cost_batch_dims_and_vmap(setup):
+    """Leading batch dims reshape correctly, and the engine-style vmap
+    over islands produces identical values."""
+    opts, cfg, tables, X, y = setup
+    trees = init_population(jax.random.PRNGKey(5), 12, cfg.mctx, jnp.float32)
+    cx = compute_complexity_batch(trees, tables)
+    c_flat, l_flat, _ = fused_cost(
+        trees, X, y, None, cx, cfg.operators, l2_dist_loss, interpret=True,
+        **BASELINE)
+    nested = jax.tree.map(lambda x: x.reshape((3, 4) + x.shape[1:]), trees)
+    c_nest, l_nest, _ = fused_cost(
+        nested, X, y, None, cx.reshape(3, 4), cfg.operators, l2_dist_loss,
+        interpret=True, **BASELINE)
+    assert c_nest.shape == (3, 4)
+    assert np.array_equal(np.asarray(c_nest).reshape(-1), np.asarray(c_flat),
+                          equal_nan=True)
+    c_vm, _, _ = jax.vmap(
+        lambda t, x: fused_cost(
+            t, X, y, None, x, cfg.operators, l2_dist_loss, interpret=True,
+            **BASELINE)
+    )(nested, cx.reshape(3, 4))
+    assert np.array_equal(np.asarray(c_vm).reshape(-1), np.asarray(c_flat),
+                          equal_nan=True)
+
+
+def test_eval_cost_batch_fuse_cost_route_bit_equal(setup):
+    """eval_cost_batch with fuse_cost=True == the materializing route,
+    and the eval_tree_block / eval_tile_rows overrides don't change
+    values (per-tree results are launch-geometry independent)."""
+    opts, cfg, tables, X, y = setup
+    trees = init_population(jax.random.PRNGKey(21), 24, cfg.mctx, jnp.float32)
+
+    from types import SimpleNamespace
+
+    D = SimpleNamespace(
+        Xt=X, y=y, weights=None, class_idx=None, x_dims=None, y_dims=None,
+        baseline_loss=BASELINE["baseline_loss"],
+        use_baseline=BASELINE["use_baseline"],
+    )
+    kw = dict(turbo=True, interpret=True, loss_function=None)
+    base = eval_cost_batch(trees, D, l2_dist_loss, tables, cfg.operators,
+                           BASELINE["parsimony"], **kw)
+    fused = eval_cost_batch(trees, D, l2_dist_loss, tables, cfg.operators,
+                            BASELINE["parsimony"], fuse_cost=True, **kw)
+    tuned = eval_cost_batch(trees, D, l2_dist_loss, tables, cfg.operators,
+                            BASELINE["parsimony"], fuse_cost=True,
+                            tree_block=4, tile_rows=4096, **kw)
+    for a, b in zip(base, fused):
+        assert np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+    for a, b in zip(base, tuned):
+        assert np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+
+
+def _run_engine(fuse, tree_block=None, debug_checks=False):
+    from symbolicregression_jl_tpu import make_dataset, search_key
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+
+    opts = sr.Options(
+        binary_operators=["+", "*"], unary_operators=["cos"], maxsize=10,
+        populations=2, population_size=12, tournament_selection_n=4,
+        ncycles_per_iteration=3, save_to_file=False, turbo=True,
+        fuse_cost_epilogue=fuse, eval_tree_block=tree_block,
+        debug_checks=debug_checks,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (64, 2)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 1.0).astype(np.float32)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(opts.elementwise_loss)
+    eng = Engine(opts, ds.nfeatures)
+    state = eng.init_state(search_key(0), ds.data, 2)
+    for _ in range(2):
+        state = eng.run_iteration(state, ds.data, jnp.int32(opts.maxsize))
+    return eng, state
+
+
+@pytest.fixture(scope="module")
+def fused_engine_run():
+    """One fused-cost engine run shared by the engine-level tests;
+    debug_checks=True runs the graftlint validate_programs audit over
+    every state the fused path produces."""
+    return _run_engine(True, debug_checks=True)
+
+
+# Engine-level A/B runs compile three full evolve programs — slow tier.
+# The fast tier still pins the fused path end-to-end through
+# test_hot_loop_guards.py's turbo-fused engine (debug_checks audit +
+# 0-traces/0-transfers) and the kernel-level parity tests above.
+@pytest.mark.slow
+def test_engine_fuse_cost_bit_identical_and_audited(fused_engine_run):
+    """Two warm iterations of the full engine: the fused-cost search
+    trajectory is bit-identical to the materializing one; debug_checks
+    runs the graftlint validate_programs audit over the fused path's
+    populations (raises on any postfix-invariant violation)."""
+    eng_a, a = fused_engine_run
+    assert eng_a.cfg.fuse_cost
+    eng_b, b = _run_engine(False)
+    assert not eng_b.cfg.fuse_cost
+    for name in ("cost", "loss", "complexity", "birth", "ref"):
+        assert np.array_equal(
+            np.asarray(getattr(a.pops, name)),
+            np.asarray(getattr(b.pops, name)), equal_nan=True), name
+    for leaf_a, leaf_b in zip(jax.tree.leaves(a.pops.trees),
+                              jax.tree.leaves(b.pops.trees)):
+        assert np.array_equal(np.asarray(leaf_a), np.asarray(leaf_b),
+                              equal_nan=True)
+    assert np.array_equal(np.asarray(a.hof.cost), np.asarray(b.hof.cost),
+                          equal_nan=True)
+
+
+@pytest.mark.slow
+def test_engine_eval_tree_block_option_plumbs_and_matches(fused_engine_run):
+    """options.eval_tree_block reaches the kernel launch (different
+    padding/blocking) without changing any per-tree result."""
+    eng_a, a = fused_engine_run
+    eng_b, b = _run_engine(True, tree_block=4)
+    assert eng_b.cfg.eval_tree_block == 4
+    assert np.array_equal(np.asarray(a.pops.cost), np.asarray(b.pops.cost),
+                          equal_nan=True)
+    assert np.array_equal(np.asarray(a.pops.loss), np.asarray(b.pops.loss),
+                          equal_nan=True)
+
+
+def test_custom_loss_function_keeps_materializing_path(setup):
+    """The custom whole-prediction loss hook must keep the jnp fallback:
+    turbo/fuse_cost are force-disabled by the options gate."""
+    opts = sr.Options(
+        binary_operators=["+", "*"], unary_operators=["cos"], maxsize=10,
+        population_size=12, tournament_selection_n=4, save_to_file=False,
+        turbo=True,
+        loss_function=lambda pred, y, w, valid: jnp.mean((pred - y) ** 2),
+    )
+    cfg = evolve_config_from_options(opts, 2)
+    assert not cfg.turbo
+    assert not cfg.fuse_cost

@@ -30,8 +30,12 @@ from symbolicregression_jl_tpu.lint.runtime import (
 )
 
 
-@pytest.fixture(scope="module")
-def engine_and_state():
+@pytest.fixture(scope="module", params=["jnp", "turbo-fused"])
+def engine_and_state(request):
+    # "turbo-fused" pins the round-6 hot path: the fused Pallas eval
+    # with the in-kernel cost epilogue (interpret mode off-TPU) must be
+    # exactly as trace- and transfer-free as the jnp fallback.
+    turbo = request.param == "turbo-fused"
     opts = Options(
         binary_operators=["+", "*"],
         unary_operators=["cos"],
@@ -42,6 +46,7 @@ def engine_and_state():
         ncycles_per_iteration=3,
         save_to_file=False,
         debug_checks=True,  # postfix-invariant audit on warm-up output
+        turbo=turbo,
     )
     rng = np.random.default_rng(0)
     X = rng.uniform(-2, 2, (64, 2)).astype(np.float32)

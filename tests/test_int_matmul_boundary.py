@@ -1,0 +1,64 @@
+"""Pin the `_INT_MATMUL` lowering boundary (VERDICT round-5 item 6).
+
+`concat_pieces`' integer-field takes route through a one-hot MXU matmul
+below `_INT_MATMUL_MAX_ROWS` mutation-batch rows and through the
+masked-sum lowering above it (evolve/step.py). The two lowerings claim
+bit-identical search trajectories — this makes that claim
+regression-proof: the same seed/config runs with the matmul forced ON
+vs forced OFF and the final population state must match to the bit.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from symbolicregression_jl_tpu import Options, make_dataset, search_key
+from symbolicregression_jl_tpu.evolve import step as step_mod
+from symbolicregression_jl_tpu.evolve.engine import Engine
+
+
+def _run(monkeypatch, limit: int):
+    # limit=0 forces the masked-sum lowering for every batch size;
+    # a large limit forces the one-hot matmul for this config's
+    # 2 islands x 3 slots x 5 attempts = 30 rows.
+    monkeypatch.setattr(step_mod, "_INT_MATMUL_MAX_ROWS", limit)
+    opts = Options(
+        binary_operators=["+", "*"],
+        unary_operators=["cos"],
+        maxsize=10,
+        populations=2,
+        population_size=12,
+        tournament_selection_n=4,
+        ncycles_per_iteration=4,
+        save_to_file=False,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (64, 2)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 1.0).astype(np.float32)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(opts.elementwise_loss)
+    eng = Engine(opts, ds.nfeatures)
+    cfg = eng.cfg
+    rows = cfg.n_islands * cfg.n_slots * cfg.attempts
+    assert cfg.mctx.int_take_matmul == (0 < rows <= limit)
+    state = eng.init_state(search_key(0), ds.data, 2)
+    # one iteration = 4 evolve cycles — enough trajectory for any
+    # lowering divergence to surface as a bit difference, and it keeps
+    # the test inside the fast tier's time budget
+    state = eng.run_iteration(state, ds.data, jnp.int32(opts.maxsize))
+    return state
+
+
+def test_int_matmul_on_vs_off_bit_identical(monkeypatch):
+    on = _run(monkeypatch, 512)       # 30 rows <= 512: matmul lowering
+    off = _run(monkeypatch, 0)        # forced masked-sum lowering
+    for name in ("cost", "loss", "complexity", "birth", "ref", "parent"):
+        assert np.array_equal(
+            np.asarray(getattr(on.pops, name)),
+            np.asarray(getattr(off.pops, name)), equal_nan=True), name
+    for a, b in zip(jax.tree.leaves(on.pops.trees),
+                    jax.tree.leaves(off.pops.trees)):
+        assert np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+    assert np.array_equal(np.asarray(on.hof.cost), np.asarray(off.hof.cost),
+                          equal_nan=True)
