@@ -75,6 +75,7 @@ def main() -> None:
     from symbolicregression_jl_tpu import Options, search_key
     from symbolicregression_jl_tpu.core.dataset import make_dataset
     from symbolicregression_jl_tpu.evolve.engine import Engine
+    from symbolicregression_jl_tpu.telemetry.schema import SCHEMA_VERSION
 
     rng = np.random.default_rng(0)
     X = rng.uniform(-3.0, 3.0, (N_ROWS, N_FEATURES)).astype(np.float32)
@@ -152,6 +153,15 @@ def main() -> None:
         "fuse_cost_epilogue": bool(engine.cfg.fuse_cost),
         "eval_tree_block": engine.cfg.eval_tree_block,
         "eval_tile_rows": engine.cfg.eval_tile_rows,
+        # graftscope provenance (round 7): whether the device counters
+        # rode the measured iterations (they are off for the headline —
+        # the bench measures the bare hot loop) and the schema version a
+        # telemetry-enabled rerun of this config would emit, so bench
+        # JSON and telemetry JSONL from the same build can be joined.
+        "telemetry": {
+            "schema": SCHEMA_VERSION,
+            "counters_enabled": bool(engine.cfg.collect_telemetry),
+        },
     }
     if n_dev == 1:
         # Projected v5e-8: measured single-chip rate x 8 devices x the

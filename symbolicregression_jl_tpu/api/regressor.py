@@ -252,6 +252,15 @@ class SRRegressor:
 
     # ------------------------------------------------------------------
     def _build_report(self) -> None:
+        # sr:host:report span (telemetry/spans.py): pareto scoring +
+        # equation stringification shows up as a named host phase in
+        # profiler captures alongside the search's sr:iteration steps.
+        from ..telemetry.spans import host_span
+
+        with host_span("report"):
+            self._build_report_inner()
+
+    def _build_report_inner(self) -> None:
         tables: List[List[EquationRecord]] = []
         best_idx: List[int] = []
         for hof in self.hofs_:

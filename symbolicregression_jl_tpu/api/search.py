@@ -28,6 +28,14 @@ from ..evolve.engine import Engine, SearchDeviceState
 from ..ops.encoding import TreeBatch, encode_population
 from ..ops.tree import Node, parse_expression
 from ..parallel.mesh import make_mesh, shard_device_data, shard_search_state
+from ..telemetry.hub import (
+    IterationContext,
+    LoggerSink,
+    ProgressSink,
+    RecorderSink,
+    Telemetry,
+)
+from ..telemetry.spans import host_span, step_span
 from ..utils.progress import ProgressBar
 from ..utils.recorder import Recorder
 from .hall_of_fame import (
@@ -687,202 +695,254 @@ def equation_search(
     num_evals0 = saved_state.num_evals if saved_state is not None else 0.0
     stop_reason = None
     cycles_remaining = total_cycles
-    recorder = Recorder(options) if options.use_recorder else None
+
+    # ---- graftscope telemetry hub (telemetry/hub.py) ----
+    # One object owns every per-iteration consumer — the SRLogger, the
+    # genealogy Recorder, the ProgressBar — as registered sinks, plus
+    # the schema-versioned JSONL stream when options.telemetry is set.
+    hub = Telemetry(
+        options,
+        run_id=ropt.run_id,
+        out_dir=out_dir,
+        niterations=ropt.niterations,
+        nout=len(datasets),
+        engine_info=[
+            {
+                "output": j + 1,
+                "turbo": bool(e.cfg.turbo),
+                "fuse_cost": bool(e.cfg.fuse_cost),
+                "collect_telemetry": bool(e.cfg.collect_telemetry),
+                "n_islands": int(n_islands),
+                "n_island_shards": int(n_island_shards),
+                "nfeatures": int(e.nfeatures),
+            }
+            for j, e in enumerate(engines)
+        ],
+    )
+    recorder = None
+    if options.use_recorder:
+        rec_path = (
+            os.path.join(out_dir, options.recorder_file)
+            if out_dir is not None
+            else options.recorder_file
+        )
+        # stream_path caps recorder_verbosity>=2 memory: iteration event
+        # batches spill to disk as they are assembled (utils/recorder.py)
+        # and merge back into the reference JSON layout at write().
+        recorder = Recorder(options, stream_path=rec_path + ".stream")
+        hub.add_sink(
+            RecorderSink(
+                recorder, [ds.variable_names for ds in datasets], rec_path
+            )
+        )
+    if ropt.logger is not None:
+        hub.add_sink(LoggerSink(ropt.logger, every=ropt.log_every_n))
     bar = ProgressBar(ropt.niterations) if ropt.progress else None
+    if bar is not None:
+        hub.add_sink(ProgressSink(bar))
 
     # Interactive quit ('q' / ctrl-d on stdin; StdinReader analogue).
     from ..utils.stdin_quit import StdinQuitWatcher
 
-    watcher = StdinQuitWatcher(
-        ropt.input_stream, force=ropt.input_stream is not None
-    )
-
-    def _budget_stop(pending_evals=None) -> Optional[str]:
-        """``pending_evals``: optional thunk for not-yet-landed evals of a
-        partially-run iteration (only forced when max_evals is set)."""
-        if watcher.check():
-            return "user_quit"
-        if (
-            options.timeout_in_seconds is not None
-            and time.time() - start_time > options.timeout_in_seconds
-        ):
-            return "timeout"
-        if options.max_evals is not None:
-            evals = (
-                num_evals0
-                + (pending_evals() if pending_evals is not None else 0.0)
-                + sum(float(s.num_evals) for s in states)
-            )
-            if evals >= options.max_evals:
-                return "max_evals"
-        return None
-
-    # ALWAYS split each iteration's evolve phase into chunks with the
-    # budget polled between launches, so a timeout / max_evals /
-    # user-quit can't overshoot by a whole iteration (the reference
-    # checks once per dispatched cycle batch,
-    # src/SymbolicRegression.jl:1202-1209). The chunk count adapts to
-    # the measured iteration time, targeting ~1 s stop latency; launch
-    # machinery is a small fraction of device time at these counts. The
-    # engine keeps chunked and single-launch iterations bit-identical
-    # (global cycle indices; one epilogue), so chunking — and re-chunking
-    # between iterations — changes only check granularity, not results.
-    _STOP_LATENCY_TARGET_S = 1.0
-    _MAX_CHUNKS = 16
-    n_chunks = min(4, options.ncycles_per_iteration)
-
-    def _chunk_sizes():
-        # EQUAL chunks whose length divides ncycles: uneven splits
-        # (e.g. 13+12) compile one evolve program per distinct length,
-        # and every adaptation of n_chunks would add more — measured as
-        # ~minutes of XLA compiles in a quickstart fit at the
-        # device-scale config. With divisor-sized chunks each
-        # adaptation costs at most one new program, often zero.
-        nc = options.ncycles_per_iteration
-        target = max(nc // n_chunks, 1)
-        length = next((d for d in range(target, nc + 1) if nc % d == 0), nc)
-        # Chunk-count bound (round-4 advisor concern, resolved by proof
-        # rather than a guard): length >= max(nc // n_chunks, 1) implies
-        # nc // length <= 2 * n_chunks for every nc, n_chunks >= 1
-        # (brute-force verified over nc, n_chunks in 1..2000), so the
-        # divisor search can never return more than twice the requested
-        # chunk count — no degenerate host-dispatch blow-up exists.
-        if length <= 2 * target or n_chunks == 1:
-            return [length] * (nc // length)
-        # No divisor near the target (prime-ish nc): fall back to
-        # near-equal chunks so mid-iteration budget polling stays live
-        # (two compiled lengths instead of one — still bounded).
-        base, rem = divmod(nc, n_chunks)
-        sizes = [base + (1 if c < rem else 0) for c in range(n_chunks)]
-        return [c for c in sizes if c > 0]
-
-    def _budget_hit(pending_evals=None) -> bool:
-        nonlocal stop_reason
-        if stop_reason is None:
-            stop_reason = _budget_stop(pending_evals)
-        return stop_reason is not None
-
-    # Host-overhead tracking (ResourceMonitor analogue,
-    # src/SearchUtils.jl:411-438).
-    from ..utils.monitor import ResourceMonitor
-
-    monitor = ResourceMonitor()
-    host_t0 = time.time()
-
-    it = 0
-    used_chunk_sets = set()
-    # Device-side cur_maxsize cache: the value only changes while the
-    # maxsize warmup ramps, so upload it on change instead of paying a
-    # (tiny, but per-iteration) host→device scalar transfer in the hot
-    # loop — keeps the loop clean under graftlint's no_transfer guard.
-    cur_maxsize_host: Optional[int] = None
-    cur_maxsize_dev = None
-    while it < ropt.niterations and stop_reason is None:
-        cur_maxsize = get_cur_maxsize(
-            options.maxsize, options.warmup_maxsize_by, total_cycles,
-            cycles_remaining,
+    try:
+        watcher = StdinQuitWatcher(
+            ropt.input_stream, force=ropt.input_stream is not None
         )
-        if cur_maxsize != cur_maxsize_host:
-            cur_maxsize_host = cur_maxsize
-            cur_maxsize_dev = jnp.int32(cur_maxsize)
-        dev_t0 = time.time()
-        monitor_host = dev_t0 - host_t0  # bookkeeping since last iteration
-        chunk_sizes = _chunk_sizes()
-        fresh_compile = tuple(chunk_sizes) not in used_chunk_sets
-        used_chunk_sets.add(tuple(chunk_sizes))
-        iter_events = [None] * len(engines)
-        for j, (engine, data) in enumerate(zip(engines, datas)):
-            out = engine.run_iteration(
-                states[j], data, cur_maxsize_dev,
-                chunk_sizes=chunk_sizes if len(chunk_sizes) > 1 else None,
-                should_stop=_budget_hit,
-            )
-            if engine.cfg.record_events:
-                states[j], iter_events[j] = out
-            else:
-                states[j] = out
-        jax.block_until_ready(states[-1].pops.cost)
+
+        def _budget_stop(pending_evals=None) -> Optional[str]:
+            """``pending_evals``: optional thunk for not-yet-landed evals of a
+            partially-run iteration (only forced when max_evals is set)."""
+            if watcher.check():
+                return "user_quit"
+            if (
+                options.timeout_in_seconds is not None
+                and time.time() - start_time > options.timeout_in_seconds
+            ):
+                return "timeout"
+            if options.max_evals is not None:
+                evals = (
+                    num_evals0
+                    + (pending_evals() if pending_evals is not None else 0.0)
+                    + sum(float(s.num_evals) for s in states)
+                )
+                if evals >= options.max_evals:
+                    return "max_evals"
+            return None
+
+        # ALWAYS split each iteration's evolve phase into chunks with the
+        # budget polled between launches, so a timeout / max_evals /
+        # user-quit can't overshoot by a whole iteration (the reference
+        # checks once per dispatched cycle batch,
+        # src/SymbolicRegression.jl:1202-1209). The chunk count adapts to
+        # the measured iteration time, targeting ~1 s stop latency; launch
+        # machinery is a small fraction of device time at these counts. The
+        # engine keeps chunked and single-launch iterations bit-identical
+        # (global cycle indices; one epilogue), so chunking — and re-chunking
+        # between iterations — changes only check granularity, not results.
+        _STOP_LATENCY_TARGET_S = 1.0
+        _MAX_CHUNKS = 16
+        n_chunks = min(4, options.ncycles_per_iteration)
+
+        def _chunk_sizes():
+            # EQUAL chunks whose length divides ncycles: uneven splits
+            # (e.g. 13+12) compile one evolve program per distinct length,
+            # and every adaptation of n_chunks would add more — measured as
+            # ~minutes of XLA compiles in a quickstart fit at the
+            # device-scale config. With divisor-sized chunks each
+            # adaptation costs at most one new program, often zero.
+            nc = options.ncycles_per_iteration
+            target = max(nc // n_chunks, 1)
+            length = next((d for d in range(target, nc + 1) if nc % d == 0), nc)
+            # Chunk-count bound (round-4 advisor concern, resolved by proof
+            # rather than a guard): length >= max(nc // n_chunks, 1) implies
+            # nc // length <= 2 * n_chunks for every nc, n_chunks >= 1
+            # (brute-force verified over nc, n_chunks in 1..2000), so the
+            # divisor search can never return more than twice the requested
+            # chunk count — no degenerate host-dispatch blow-up exists.
+            if length <= 2 * target or n_chunks == 1:
+                return [length] * (nc // length)
+            # No divisor near the target (prime-ish nc): fall back to
+            # near-equal chunks so mid-iteration budget polling stays live
+            # (two compiled lengths instead of one — still bounded).
+            base, rem = divmod(nc, n_chunks)
+            sizes = [base + (1 if c < rem else 0) for c in range(n_chunks)]
+            return [c for c in sizes if c > 0]
+
+        def _budget_hit(pending_evals=None) -> bool:
+            nonlocal stop_reason
+            if stop_reason is None:
+                stop_reason = _budget_stop(pending_evals)
+            return stop_reason is not None
+
+        # Host-overhead tracking (ResourceMonitor analogue,
+        # src/SearchUtils.jl:411-438).
+        from ..utils.monitor import ResourceMonitor
+
+        monitor = ResourceMonitor()
         host_t0 = time.time()
-        # Adapt chunk count toward the stop-latency target using this
-        # iteration's measured device time, quantized to powers of two —
-        # each distinct chunk-size set compiles its own evolve program
-        # (tens of seconds at device-scale configs), so the count must
-        # not wander with timing noise, and an iteration that COMPILED a
-        # new set must never feed the adaptation (its wall time is
-        # compile-dominated; adapting off it churned chunk lengths and
-        # recompiled every iteration). The first iteration is skipped
-        # for the same reason.
-        if it >= 1 and not fresh_compile:  # 0 == first iteration
-            target = (host_t0 - dev_t0) / _STOP_LATENCY_TARGET_S
-            cap = min(options.ncycles_per_iteration, _MAX_CHUNKS)
-            n_chunks = 1
-            while n_chunks < cap and n_chunks * 2 <= target:
-                n_chunks *= 2
-        monitor.record(host_t0 - dev_t0, monitor_host)
-        monitor.check_and_warn(ropt.verbosity)
-        cycles_remaining -= options.ncycles_per_iteration
-        it += 1
 
-        # Host-side bookkeeping once per iteration (not per cycle).
-        total_evals = num_evals0 + sum(
-            float(s.num_evals) for s in states
-        )
-        for j, (engine, ds) in enumerate(zip(engines, datasets)):
-            hofs[j] = HallOfFame.from_device(
-                states[j].hof, options.operators, template=engine.template
+        it = 0
+        used_chunk_sets = set()
+        # Device-side cur_maxsize cache: the value only changes while the
+        # maxsize warmup ramps, so upload it on change instead of paying a
+        # (tiny, but per-iteration) host→device scalar transfer in the hot
+        # loop — keeps the loop clean under graftlint's no_transfer guard.
+        cur_maxsize_host: Optional[int] = None
+        cur_maxsize_dev = None
+        while it < ropt.niterations and stop_reason is None:
+            cur_maxsize = get_cur_maxsize(
+                options.maxsize, options.warmup_maxsize_by, total_cycles,
+                cycles_remaining,
             )
-            if out_dir is not None:
-                fname = (
-                    "hall_of_fame.csv"
-                    if len(datasets) == 1
-                    else f"hall_of_fame_output{j + 1}.csv"
-                )
-                save_hall_of_fame_csv(
-                    os.path.join(out_dir, fname), hofs[j], options.operators,
-                    variable_names=ds.variable_names,
-                )
-        if out_dir is not None and it % ropt.checkpoint_every_n == 0:
-            # Periodic full-state checkpoint next to the CSVs: kill the
-            # process at a checkpoint boundary and resume with
-            # equation_search(..., saved_state=<path>). Not every
-            # iteration — the population pytree is much larger than the
-            # HoF CSVs; the final/stopping state is written once after
-            # the loop.
-            from .checkpoint import save_search_state
+            if cur_maxsize != cur_maxsize_host:
+                cur_maxsize_host = cur_maxsize
+                cur_maxsize_dev = jnp.int32(cur_maxsize)
+            dev_t0 = time.time()
+            monitor_host = dev_t0 - host_t0  # bookkeeping since last iteration
+            chunk_sizes = _chunk_sizes()
+            fresh_compile = tuple(chunk_sizes) not in used_chunk_sets
+            used_chunk_sets.add(tuple(chunk_sizes))
+            iter_events = [None] * len(engines)
+            # sr:iteration span: one profiler step per search iteration, so a
+            # perfetto/xplane capture lines up device work with iterations.
+            with step_span(it + 1):
+                for j, (engine, data) in enumerate(zip(engines, datas)):
+                    out = engine.run_iteration(
+                        states[j], data, cur_maxsize_dev,
+                        chunk_sizes=chunk_sizes if len(chunk_sizes) > 1 else None,
+                        should_stop=_budget_hit,
+                    )
+                    if engine.cfg.record_events:
+                        states[j], iter_events[j] = out
+                    else:
+                        states[j] = out
+                jax.block_until_ready(states[-1].pops.cost)
+            host_t0 = time.time()
+            # Adapt chunk count toward the stop-latency target using this
+            # iteration's measured device time, quantized to powers of two —
+            # each distinct chunk-size set compiles its own evolve program
+            # (tens of seconds at device-scale configs), so the count must
+            # not wander with timing noise, and an iteration that COMPILED a
+            # new set must never feed the adaptation (its wall time is
+            # compile-dominated; adapting off it churned chunk lengths and
+            # recompiled every iteration). The first iteration is skipped
+            # for the same reason.
+            if it >= 1 and not fresh_compile:  # 0 == first iteration
+                target = (host_t0 - dev_t0) / _STOP_LATENCY_TARGET_S
+                cap = min(options.ncycles_per_iteration, _MAX_CHUNKS)
+                n_chunks = 1
+                while n_chunks < cap and n_chunks * 2 <= target:
+                    n_chunks *= 2
+            monitor.record(host_t0 - dev_t0, monitor_host)
+            monitor.check_and_warn(ropt.verbosity)
+            cycles_remaining -= options.ncycles_per_iteration
+            it += 1
 
-            save_search_state(
-                os.path.join(out_dir, "search_state.pkl"),
-                SearchState(
-                    device_states=list(states),
-                    hofs=hofs,
-                    options=options,
-                    num_evals=total_evals,
-                    nfeatures=[ds.nfeatures for ds in datasets],
-                ),
+            # Host-side bookkeeping once per iteration (not per cycle).
+            total_evals = num_evals0 + sum(
+                float(s.num_evals) for s in states
             )
+            with host_span("hof_decode"):
+                for j, engine in enumerate(engines):
+                    hofs[j] = HallOfFame.from_device(
+                        states[j].hof, options.operators,
+                        template=engine.template,
+                    )
+            with host_span("checkpoint"):
+                for j, ds in enumerate(datasets):
+                    if out_dir is not None:
+                        fname = (
+                            "hall_of_fame.csv"
+                            if len(datasets) == 1
+                            else f"hall_of_fame_output{j + 1}.csv"
+                        )
+                        save_hall_of_fame_csv(
+                            os.path.join(out_dir, fname), hofs[j],
+                            options.operators,
+                            variable_names=ds.variable_names,
+                        )
+                if out_dir is not None and it % ropt.checkpoint_every_n == 0:
+                    # Periodic full-state checkpoint next to the CSVs: kill
+                    # the process at a checkpoint boundary and resume with
+                    # equation_search(..., saved_state=<path>). Not every
+                    # iteration — the population pytree is much larger than
+                    # the HoF CSVs; the final/stopping state is written once
+                    # after the loop.
+                    from .checkpoint import save_search_state
 
-        if recorder is not None:
-            for j, ds in enumerate(datasets):
-                recorder.record_iteration(
-                    it, j, states[j], hofs[j], float(states[j].num_evals),
-                    variable_names=ds.variable_names,
-                    events=iter_events[j],
-                )
+                    save_search_state(
+                        os.path.join(out_dir, "search_state.pkl"),
+                        SearchState(
+                            device_states=list(states),
+                            hofs=hofs,
+                            options=options,
+                            num_evals=total_evals,
+                            nfeatures=[ds.nfeatures for ds in datasets],
+                        ),
+                    )
 
-        if ropt.logger is not None and it % max(ropt.log_every_n, 1) == 0:
-            ropt.logger.log_iteration(
-                iteration=it, hofs=hofs, states=states, options=options,
-                num_evals=total_evals, elapsed=time.time() - start_time,
-            )
-
-        if bar is not None or ropt.verbosity >= 2:
+            # One hub dispatch replaces the old ad-hoc recorder/logger/bar
+            # wiring: fetch device counters, merge timings + compile events,
+            # maybe emit the JSONL iteration event, run every sink.
             elapsed = time.time() - start_time
             best_loss = min(
                 (e.loss for h in hofs for e in h.entries), default=np.inf
             )
             rate = total_evals / max(elapsed, 1e-9)
-            if bar is not None:
-                bar.update(it, best_loss=best_loss, evals_per_sec=rate)
+            hub.iteration(IterationContext(
+                iteration=it,
+                states=states,
+                hofs=hofs,
+                options=options,
+                num_evals=total_evals,
+                elapsed=elapsed,
+                best_loss=best_loss,
+                evals_per_sec=rate,
+                device_s=host_t0 - dev_t0,
+                host_s=monitor_host,
+                host_fraction=monitor.estimate_work_fraction(),
+                events=iter_events,
+            ))
             if ropt.verbosity >= 2:
                 print(
                     f"[iter {it}/{ropt.niterations}] "
@@ -891,48 +951,47 @@ def equation_search(
                     f"{monitor.estimate_work_fraction():.0%})"
                 )
 
-        # ---- early stopping (src/SearchUtils.jl:387-409) ----
-        if options.early_stop_condition is not None:
-            hit = any(
-                options.early_stop_condition(e.loss, e.complexity)
-                for h in hofs
-                for e in h.entries
+            # ---- early stopping (src/SearchUtils.jl:387-409) ----
+            if options.early_stop_condition is not None:
+                hit = any(
+                    options.early_stop_condition(e.loss, e.complexity)
+                    for h in hofs
+                    for e in h.entries
+                )
+                if hit:
+                    stop_reason = "early_stop_condition"
+            if stop_reason is None:
+                stop_reason = _budget_stop()
+
+        watcher.stop()
+        if out_dir is not None and it > 0:
+            # Guarantee the final/stopping state is checkpointed even when
+            # the stop was detected after the periodic write (early-stop
+            # condition or end-of-loop budget check).
+            from .checkpoint import save_search_state
+
+            save_search_state(
+                os.path.join(out_dir, "search_state.pkl"),
+                SearchState(
+                    device_states=list(states),
+                    hofs=hofs,
+                    options=options,
+                    num_evals=num_evals0 + sum(float(s.num_evals) for s in states),
+                    nfeatures=[ds.nfeatures for ds in datasets],
+                ),
             )
-            if hit:
-                stop_reason = "early_stop_condition"
-        if stop_reason is None:
-            stop_reason = _budget_stop()
-
-    watcher.stop()
-    if out_dir is not None and it > 0:
-        # Guarantee the final/stopping state is checkpointed even when
-        # the stop was detected after the periodic write (early-stop
-        # condition or end-of-loop budget check).
-        from .checkpoint import save_search_state
-
-        save_search_state(
-            os.path.join(out_dir, "search_state.pkl"),
-            SearchState(
-                device_states=list(states),
-                hofs=hofs,
-                options=options,
-                num_evals=num_evals0 + sum(float(s.num_evals) for s in states),
-                nfeatures=[ds.nfeatures for ds in datasets],
-            ),
+        # Flush any partial telemetry interval, emit run_end, close sinks
+        # (ProgressBar close, Recorder final-state + write).
+        hub.finish(
+            stop_reason=stop_reason or "niterations",
+            num_evals=num_evals0 + sum(float(s.num_evals) for s in states),
+            elapsed=time.time() - start_time,
         )
-    if bar is not None:
-        bar.close()
-    if recorder is not None:
-        recorder.record_final("stop_reason", stop_reason or "niterations")
-        recorder.record_final(
-            "num_evals", num_evals0 + sum(float(s.num_evals) for s in states)
-        )
-        rec_path = (
-            os.path.join(out_dir, options.recorder_file)
-            if out_dir is not None
-            else options.recorder_file
-        )
-        recorder.write(rec_path)
+    finally:
+        # A failing or interrupted search must still release the
+        # hub's process-global jax.monitoring compile listener
+        # (idempotent after a clean finish).
+        hub.close()
 
     if ropt.verbosity >= 1:
         for j, (hof, ds) in enumerate(zip(hofs, datasets)):

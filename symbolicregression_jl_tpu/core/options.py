@@ -338,6 +338,19 @@ class Options:
         verbosity: Optional[int] = None,
         print_precision: int = 5,
         progress: Optional[bool] = None,
+        # graftscope telemetry (telemetry/ package, docs/OBSERVABILITY.md):
+        # device-side counters ride the evolve scan carry (0 extra
+        # dispatches/transfers/retraces in the hot loop) and the host hub
+        # emits schema-versioned JSONL (`graftscope.v1`) merging them
+        # with timings and jax.monitoring compile events. `telemetry`
+        # turns the JSONL stream on; the counters themselves are
+        # collected whenever it is set. `telemetry_file` is relative to
+        # the run's output directory unless absolute;
+        # `telemetry_interval` emits one `iteration` event per N
+        # iterations (counters summed across the interval).
+        telemetry: bool = False,
+        telemetry_file: str = "telemetry.jsonl",
+        telemetry_interval: int = 1,
         # Run the graftlint runtime auditor (lint/runtime.py
         # validate_programs) over every engine state: postfix-encoding
         # invariants are re-checked after init and after each iteration's
@@ -519,6 +532,9 @@ class Options:
         self.deterministic = bool(deterministic)
         self.seed = seed
         self.verbosity = verbosity
+        self.telemetry = bool(telemetry)
+        self.telemetry_file = str(telemetry_file)
+        self.telemetry_interval = int(telemetry_interval)
         self.debug_checks = bool(debug_checks)
         self.print_precision = int(print_precision)
         self.progress = progress
@@ -544,6 +560,8 @@ class Options:
             raise ValueError("eval_tree_block must be positive")
         if self.eval_tile_rows is not None and self.eval_tile_rows <= 0:
             raise ValueError("eval_tile_rows must be positive")
+        if self.telemetry_interval < 1:
+            raise ValueError("telemetry_interval must be >= 1")
 
     @property
     def nops(self):

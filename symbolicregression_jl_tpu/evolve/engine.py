@@ -85,6 +85,11 @@ class SearchDeviceState:
     ref: jax.Array          # [I] int32 per-island lineage counters
     num_evals: jax.Array    # scalar float32
     key: jax.Array          # PRNG key
+    # graftscope counters for the LAST iteration (options.telemetry;
+    # telemetry/counters.py IterationTelemetry, summed over islands) —
+    # reset in-graph each iteration, fetched by the host Telemetry hub
+    # with the per-iteration state pull. None when telemetry is off.
+    telem: Optional[object] = None
 
 
 def _move_window(freq, window_size: float, maxsize: int):
@@ -268,6 +273,15 @@ class Engine:
         stats = RunningStats(
             frequencies=freq, normalized_frequencies=freq / jnp.sum(freq)
         )
+        telem = None
+        if cfg.collect_telemetry:
+            # Pre-seed the telemetry slot so the first iteration's input
+            # pytree already has the counter structure — otherwise the
+            # None -> IterationTelemetry switch would cost one extra
+            # trace of the iteration program.
+            from ..telemetry.counters import empty_iteration_telemetry
+
+            telem = empty_iteration_telemetry(cfg.maxsize)
         return SearchDeviceState(
             pops=pops,
             hof=empty_hof(cfg.maxsize, cfg.max_nodes, self.dtype,
@@ -279,6 +293,7 @@ class Engine:
             ref=jnp.full((n_islands,), P, jnp.int32),
             num_evals=jnp.float32(n_islands * P),
             key=k_state,
+            telem=telem,
         )
 
     # ------------------------------------------------------------------
@@ -330,18 +345,23 @@ class Engine:
         pops, birth, ref = state.pops, state.birth, state.ref
         c0 = 0
         ev_chunks = []
+        tele = None
         for i, nc in enumerate(chunk_sizes):
             fn = self._chunk_fn(nc, batching=batch_idx is not None)
             out = fn(
                 pops, birth, ref, state.stats.normalized_frequencies, data,
                 cur_maxsize, k_cycle, batch_idx, jnp.int32(c0), carry
             )
+            pops, best_seen, nev, birth, ref, marks = out[:6]
+            pos = 6
+            if cfg.collect_telemetry:
+                tele = out[pos]
+                pos += 1
             if cfg.record_events:
-                (pops, best_seen, nev, birth, ref, marks), ev = out[:6], out[6]
-                ev_chunks.append(ev)
-            else:
-                pops, best_seen, nev, birth, ref, marks = out
+                ev_chunks.append(out[pos])
             carry = (best_seen, nev, marks)
+            if cfg.collect_telemetry:
+                carry = carry + (tele,)
             c0 += nc
             if should_stop is not None and i < len(chunk_sizes) - 1:
                 # Offer this iteration's partial evals lazily: only a
@@ -359,6 +379,8 @@ class Engine:
                 if should_stop(pending):
                     break
         evolved = (pops, best_seen, nev, birth, ref, marks)
+        if cfg.collect_telemetry:
+            evolved = evolved + (tele,)
         new_state = self._epilogue_fn(
             state, data, cur_maxsize, evolved, key, k_opt, k_mig, batch_idx
         )
@@ -402,6 +424,12 @@ class Engine:
                     (jnp.zeros((I, P), jnp.bool_),
                      jnp.zeros((I, P), jnp.bool_)),
                 )
+                if cfg.collect_telemetry:
+                    from ..telemetry.counters import empty_cycle_telemetry
+
+                    carry = carry + (jax.tree.map(
+                        lambda x: jnp.broadcast_to(x, (I,) + x.shape),
+                        empty_cycle_telemetry()),)
                 return cur_maxsize, key, k_cycle, k_opt, k_mig, batch_idx, \
                     carry
 
@@ -507,10 +535,11 @@ class Engine:
             state.stats.normalized_frequencies, data, cur_maxsize,
             k_cycle, batch_idx, jnp.int32(0), None, cfg,
         )
+        n = 7 if cfg.collect_telemetry else 6
         events = None
         if cfg.record_events:
-            events = evolved[6]
-            evolved = evolved[:6]
+            events = evolved[n]
+            evolved = evolved[:n]
         new_state = self._epilogue_part(
             state, data, cur_maxsize, evolved, key, k_opt, k_mig, batch_idx,
             cfg,
@@ -740,7 +769,11 @@ class Engine:
             cfg.batch_size / data.y.shape[0] if cfg.batching else 1.0
         )
 
-        pops, best_seen, nev, birth, ref, marks = evolved
+        if cfg.collect_telemetry:
+            pops, best_seen, nev, birth, ref, marks, tele = evolved
+        else:
+            pops, best_seen, nev, birth, ref, marks = evolved
+            tele = None
         simp_mark, opt_mark = marks  # [I, P] bools
         num_evals = state.num_evals + jnp.sum(nev) * eval_fraction
 
@@ -888,9 +921,46 @@ class Engine:
             normalized_frequencies=freq / jnp.sum(freq),
         )
 
+        telem = None
+        if cfg.collect_telemetry:
+            # This iteration's counters: per-island cycle counters summed
+            # over the island axis (a collective under a sharded island
+            # axis — GSPMD-land, outside the shard_map'd phases), plus
+            # the finalize re-eval, the post-migration population
+            # loss histogram, and the member-duplication stats that
+            # measure the dedup hit-rate. All in-graph: the host fetches
+            # state.telem with the per-iteration state pull.
+            from ..telemetry.counters import (
+                IterationTelemetry,
+                loss_histogram,
+                member_dup_stats,
+            )
+
+            cyc = jax.tree.map(lambda x: jnp.sum(x, axis=0), tele)
+            cyc = dataclasses.replace(
+                cyc,
+                eval_rows=cyc.eval_rows + jnp.int32(I * P),
+                eval_launches=cyc.eval_launches + jnp.int32(1),
+            )
+            if self.n_island_shards > 1:
+                # Global dup stats would sort across shards every
+                # iteration (see counters.IterationTelemetry docstring);
+                # report zeros instead, like the dedup path itself.
+                fin_rows = jnp.int32(0)
+                fin_unique = jnp.int32(0)
+            else:
+                fin_rows, fin_unique = member_dup_stats(pops.trees)
+            telem = IterationTelemetry(
+                cycle=cyc,
+                finalize_rows=fin_rows,
+                finalize_unique=fin_unique,
+                loss_hist=loss_histogram(pops.loss),
+                cx_hist=hist.astype(jnp.int32),
+            )
+
         return SearchDeviceState(
             pops=pops, hof=hof, stats=stats, birth=birth, ref=ref,
-            num_evals=num_evals, key=key,
+            num_evals=num_evals, key=key, telem=telem,
         )
 
 

@@ -137,6 +137,11 @@ class EvolveConfig(NamedTuple):
     eval_tree_block: int = 8
     eval_tile_rows: int = 16384
     fuse_cost: bool = False
+    # graftscope device counters (options.telemetry): generation_step
+    # emits a CycleTelemetry from values it already computed, s_r_cycle
+    # accumulates it in the scan carry — the search trajectory is
+    # bit-identical with the flag on or off (tests/test_telemetry.py).
+    collect_telemetry: bool = False
 
     @property
     def n_slots(self) -> int:
@@ -238,6 +243,7 @@ def evolve_config_from_options(options: Options, nfeatures: int,
         fuse_cost=turbo and (
             getattr(options, "fuse_cost_epilogue", None) is not False
         ),
+        collect_telemetry=bool(getattr(options, "telemetry", False)),
     )
 
 
@@ -871,6 +877,7 @@ def generation_step(
         )  # [B + k2, ...]
         packed_params = jnp.concatenate([cand1_params, params2_sel], axis=0)
         eval_batch = packed
+        n_eval_rows = B + k2
         c_all, l_all, x_all = _eval(packed, packed_params)
         inf = jnp.asarray(jnp.inf, c_all.dtype)
 
@@ -896,6 +903,7 @@ def generation_step(
         if k2 == 0:
             # crossover disabled: cand2 is never consulted
             eval_batch = cand1
+            n_eval_rows = B
             cost1, loss1, cx1 = _eval(cand1, cand1_params)
             inf = jnp.asarray(jnp.inf, cost1.dtype)
             cost = jnp.stack([cost1, jnp.full((B,), inf)], axis=1)
@@ -910,6 +918,7 @@ def generation_step(
             both_params = jnp.stack([cand1_params, cand2_params], axis=1)
             eval_batch = jax.tree.map(
                 lambda x: x.reshape((2 * B,) + x.shape[2:]), both)
+            n_eval_rows = 2 * B
             cost, loss, complexity = _eval(both, both_params)
     needs_eval = jnp.stack([needs_eval1, needs_eval2], axis=1)
     num_evals = jnp.sum(needs_eval.astype(jnp.float32))
@@ -992,6 +1001,19 @@ def generation_step(
     # (crossover_generation, src/Mutate.jl:661-733).
     xo_nan = jnp.isnan(cost[:, 0]) | jnp.isnan(cost[:, 1])
     xo_replace = xo_success & ~xo_nan
+
+    tele = None
+    if cfg.collect_telemetry:
+        from ..telemetry.counters import step_telemetry
+
+        tele = step_telemetry(
+            kind=kind, is_xover=is_xover, immediate=immediate,
+            accepted_mut=accepted_mut, xo_replace=xo_replace,
+            mut_success=mut_success, xo_success=xo_success,
+            after_cost=after_cost, xo_nan=xo_nan, anneal_ok=anneal_ok,
+            cost=cost, needs_eval1=needs_eval1, needs_eval2=needs_eval2,
+            n_eval_rows=n_eval_rows,
+        )
 
     replace1 = jnp.where(is_xover, xo_replace, mut_replace)
     replace2 = is_xover & xo_replace
@@ -1094,6 +1116,8 @@ def generation_step(
     )
     if marks is None:
         out = (new_pop, num_evals, birth0 + nb, ref0 + nb)
+        if cfg.collect_telemetry:
+            out = out + (tele,)
         if cfg.record_events:
             out = out + (events,)
         if return_candidates:
@@ -1114,6 +1138,8 @@ def generation_step(
         scatter(opt_mark, opt_flags),
     )
     out = (new_pop, num_evals, birth0 + nb, ref0 + nb, new_marks)
+    if cfg.collect_telemetry:
+        out = out + (tele,)
     if cfg.record_events:
         out = out + (events,)
     if return_candidates:
@@ -1214,8 +1240,12 @@ def s_r_cycle(
     """
     ncycles = cfg.ncycles
     total = total_cycles if total_cycles is not None else ncycles
+    tele0 = None
     if carry_in is not None:
-        hof0, nev0, marks0 = carry_in
+        if cfg.collect_telemetry:
+            hof0, nev0, marks0, tele0 = carry_in
+        else:
+            hof0, nev0, marks0 = carry_in
     else:
         hof0 = empty_hof(
             cfg.maxsize, cfg.max_nodes, pop.cost.dtype, cfg.n_params,
@@ -1225,11 +1255,15 @@ def s_r_cycle(
         P = pop.cost.shape[0]
         marks0 = (jnp.zeros((P,), jnp.bool_), jnp.zeros((P,), jnp.bool_))
         nev0 = jnp.float32(0.0)
+        if cfg.collect_telemetry:
+            from ..telemetry.counters import empty_cycle_telemetry
+
+            tele0 = empty_cycle_telemetry()
     if c0 is None:
         c0 = jnp.int32(0)
 
     def cycle(carry, c):
-        pop, hof, birth, ref, nev, marks = carry
+        pop, hof, birth, ref, nev, marks, tele = carry
         gc = c + c0  # global cycle index
         if cfg.annealing and total > 1:
             temperature = 1.0 - gc.astype(pop.cost.dtype) / (total - 1)
@@ -1241,19 +1275,25 @@ def s_r_cycle(
             cfg, options, tables, elementwise_loss, batch_idx=batch_idx,
             marks=marks,
         )
-        if cfg.record_events:
-            pop, nev_c, birth, ref, marks, events = out
-        else:
-            pop, nev_c, birth, ref, marks = out
-            events = None
-        hof = update_hof(hof, pop, cfg.maxsize)
-        return (pop, hof, birth, ref, nev + nev_c, marks), events
+        pop, nev_c, birth, ref, marks = out[:5]
+        pos = 5
+        if cfg.collect_telemetry:
+            from ..telemetry.counters import add_cycle_telemetry
 
-    (pop, hof, birth0, ref0, num_evals, marks), events = jax.lax.scan(
-        cycle, (pop, hof0, birth0, ref0, nev0, marks0),
+            tele = add_cycle_telemetry(tele, out[pos])
+            pos += 1
+        events = out[pos] if cfg.record_events else None
+        hof = update_hof(hof, pop, cfg.maxsize)
+        return (pop, hof, birth, ref, nev + nev_c, marks, tele), events
+
+    (pop, hof, birth0, ref0, num_evals, marks, tele), events = jax.lax.scan(
+        cycle, (pop, hof0, birth0, ref0, nev0, marks0, tele0),
         jnp.arange(ncycles, dtype=jnp.int32),
     )
+    ret = (pop, hof, num_evals, birth0, ref0, marks)
+    if cfg.collect_telemetry:
+        ret = ret + (tele,)
     if cfg.record_events:
         # events: CycleEvents of [ncycles, 2B] arrays
-        return pop, hof, num_evals, birth0, ref0, marks, events
-    return pop, hof, num_evals, birth0, ref0, marks
+        ret = ret + (events,)
+    return ret

@@ -1,0 +1,45 @@
+"""graftscope — device-resident search telemetry, span tracing, run reports.
+
+The observability layer of the TPU port (ROADMAP: "what is the search
+doing and what is pacing it", answerable on every run):
+
+- :mod:`.counters` — device-side metric accumulators threaded through the
+  evolve scan carry (mutation proposals/accepts per kind, invalid-eval
+  fraction, dedup hit-rate, eval launches, population histograms). They
+  ride the engine state, so the host fetches them with the existing
+  per-iteration state pull: 0 extra dispatches, 0 extra transfers,
+  0 retraces in the hot loop.
+- :mod:`.hub` — the host-side ``Telemetry`` hub: merges device counters
+  with ``ResourceMonitor`` timings and ``jax.monitoring`` compile
+  events, emits schema-versioned JSONL (:mod:`.schema`), and dispatches
+  registered sinks (``SRLogger``, ``Recorder``, ``ProgressBar``).
+- :mod:`.spans` — ``jax.profiler`` span annotations so a perfetto /
+  xplane capture lines up with search iterations and host phases.
+- :mod:`.report` — the run-report CLI::
+
+      python -m symbolicregression_jl_tpu.telemetry report run.jsonl
+      python -m symbolicregression_jl_tpu.telemetry validate run.jsonl
+
+Enable with ``Options(telemetry=True)``; see docs/OBSERVABILITY.md.
+"""
+
+from .counters import (
+    CycleTelemetry,
+    IterationTelemetry,
+    empty_cycle_telemetry,
+    empty_iteration_telemetry,
+)
+from .hub import IterationContext, Telemetry
+from .schema import SCHEMA_VERSION, validate_event, validate_lines
+
+__all__ = [
+    "CycleTelemetry",
+    "IterationTelemetry",
+    "IterationContext",
+    "Telemetry",
+    "SCHEMA_VERSION",
+    "empty_cycle_telemetry",
+    "empty_iteration_telemetry",
+    "validate_event",
+    "validate_lines",
+]

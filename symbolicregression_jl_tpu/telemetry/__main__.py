@@ -1,0 +1,8 @@
+"""``python -m symbolicregression_jl_tpu.telemetry`` entry point."""
+
+import sys
+
+from .report import main
+
+if __name__ == "__main__":
+    sys.exit(main())

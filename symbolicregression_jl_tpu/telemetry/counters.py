@@ -1,0 +1,282 @@
+"""Device-side search telemetry accumulators.
+
+The evolve scan (evolve/step.py `s_r_cycle`) runs entirely inside one
+XLA program per iteration; everything that happens in it — which
+mutation kinds get sampled, how many candidates survive constraints and
+annealing, how many evals produce non-finite losses — is invisible to
+the host unless it is accumulated *in-graph*. These counters are small
+int32 vectors carried through the scan (`CycleTelemetry`), summed over
+islands in the iteration epilogue, and stored on the engine state
+(`IterationTelemetry` on ``SearchDeviceState.telem``), so the host
+fetches them with the same per-iteration state pull it already performs:
+the hot loop stays at 0 extra dispatches, 0 extra transfers, 0 retraces
+(pinned by tests/test_hot_loop_guards.py with telemetry enabled).
+
+Counters are PER ITERATION (reset in-graph at each iteration start, not
+cumulative): int32 cannot overflow within one iteration at any plausible
+config, and the host-side `Telemetry` hub does the cross-iteration
+accumulation in Python ints.
+
+Counter semantics (schema `graftscope.v1`, docs/OBSERVABILITY.md):
+
+- ``proposed[k]`` — generation-step slots whose sampled operation was
+  mutation kind ``k`` (index order = ``MUTATION_KINDS``; the last index
+  is crossover). One proposal per slot per cycle.
+- ``accepted[k]`` — proposals that replaced a member with the *new*
+  genome (mutations: passed constraints + finite cost + annealing;
+  immediate kinds count as accepted, matching the reference's
+  return_immediately contract; crossover: both-children-valid
+  replacement). Kept-parent fallbacks (skip_mutation_failures=False) are
+  NOT accepts.
+- ``reject_reasons[r]`` — slot-level rejection reason histogram, codes
+  matching `CycleEvents.reject_reason` (0 none, 1 constraint/no-valid-
+  candidate, 2 non-finite cost, 3 annealing/frequency rejection).
+- ``candidates`` — candidate evals actually needed (the raw
+  ``num_evals`` increments, before any minibatch fraction scaling).
+- ``invalid`` — needed candidates whose evaluated cost came back
+  non-finite (NaN/inf loss); ``invalid / candidates`` is the
+  invalid-candidate fraction.
+- ``eval_rows`` / ``eval_launches`` — rows through / launches of the
+  candidate-eval kernel (per island in the cycle part; the iteration
+  epilogue adds the finalize re-eval).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.options import MUTATION_KINDS
+
+__all__ = [
+    "N_KIND_SLOTS",
+    "N_REASONS",
+    "LOSS_HIST_BINS",
+    "LOSS_HIST_LO",
+    "LOSS_HIST_HI",
+    "CycleTelemetry",
+    "IterationTelemetry",
+    "empty_cycle_telemetry",
+    "empty_iteration_telemetry",
+    "step_telemetry",
+    "add_cycle_telemetry",
+    "member_dup_stats",
+    "loss_histogram",
+]
+
+# Mutation kinds + 1 crossover pseudo-kind (same convention as
+# CycleEvents.kind in evolve/step.py).
+N_KIND_SLOTS = len(MUTATION_KINDS) + 1
+N_REASONS = 4  # none / constraint / invalid / annealing
+
+# Population-loss histogram: log10(loss) bins over [LO, HI); finite
+# losses <= 0 (perfect fits) clamp into the first bin, non-finite losses
+# are not counted.
+LOSS_HIST_BINS = 32
+LOSS_HIST_LO = -8.0
+LOSS_HIST_HI = 8.0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CycleTelemetry:
+    """Per-cycle counters accumulated in the evolve scan carry.
+
+    Shapes are leading-axis-free here; the engine vmaps one instance per
+    island ([I, ...]) and sums over islands in the epilogue."""
+
+    proposed: jax.Array        # [N_KIND_SLOTS] int32
+    accepted: jax.Array        # [N_KIND_SLOTS] int32
+    reject_reasons: jax.Array  # [N_REASONS] int32
+    candidates: jax.Array      # [] int32
+    invalid: jax.Array         # [] int32
+    eval_rows: jax.Array       # [] int32
+    eval_launches: jax.Array   # [] int32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class IterationTelemetry:
+    """One iteration's telemetry, stored on ``SearchDeviceState.telem``.
+
+    ``finalize_rows`` / ``finalize_unique`` measure structural member
+    duplication in the finalize re-eval batch — the duplication the
+    fused dedup path exploits (``finalize_rows - finalize_unique`` =
+    dedup hits; zeros when the island axis is sharded, where dedup is
+    disabled and a global sort would need per-iteration collectives)."""
+
+    cycle: CycleTelemetry
+    finalize_rows: jax.Array     # [] int32
+    finalize_unique: jax.Array   # [] int32
+    loss_hist: jax.Array         # [LOSS_HIST_BINS] int32
+    cx_hist: jax.Array           # [maxsize] int32
+
+
+def empty_cycle_telemetry() -> CycleTelemetry:
+    z = jnp.int32(0)
+    return CycleTelemetry(
+        proposed=jnp.zeros((N_KIND_SLOTS,), jnp.int32),
+        accepted=jnp.zeros((N_KIND_SLOTS,), jnp.int32),
+        reject_reasons=jnp.zeros((N_REASONS,), jnp.int32),
+        candidates=z,
+        invalid=z,
+        eval_rows=z,
+        eval_launches=z,
+    )
+
+
+def empty_iteration_telemetry(maxsize: int) -> IterationTelemetry:
+    z = jnp.int32(0)
+    return IterationTelemetry(
+        cycle=empty_cycle_telemetry(),
+        finalize_rows=z,
+        finalize_unique=z,
+        loss_hist=jnp.zeros((LOSS_HIST_BINS,), jnp.int32),
+        cx_hist=jnp.zeros((maxsize,), jnp.int32),
+    )
+
+
+def add_cycle_telemetry(a: CycleTelemetry, b: CycleTelemetry) -> CycleTelemetry:
+    return jax.tree.map(lambda x, y: x + y, a, b)
+
+
+def step_telemetry(
+    *,
+    kind: jax.Array,          # [B] int32 sampled mutation kind
+    is_xover: jax.Array,      # [B] bool
+    immediate: jax.Array,     # [B] bool
+    accepted_mut: jax.Array,  # [B] bool
+    xo_replace: jax.Array,    # [B] bool
+    mut_success: jax.Array,   # [B] bool
+    xo_success: jax.Array,    # [B] bool
+    after_cost: jax.Array,    # [B] candidate-1 cost
+    xo_nan: jax.Array,        # [B] bool either crossover child non-finite
+    anneal_ok: jax.Array,     # [B] bool
+    cost: jax.Array,          # [B, 2] both babies' costs
+    needs_eval1: jax.Array,   # [B] bool
+    needs_eval2: jax.Array,   # [B] bool
+    n_eval_rows: int,         # static rows in this step's eval launch
+) -> CycleTelemetry:
+    """Counters for one generation step, from values the step already
+    computed (no extra RNG draws, no change to the search dataflow — the
+    telemetry=on/off search trajectories are bit-identical)."""
+    nk = len(MUTATION_KINDS)
+    k_eff = jnp.where(is_xover, jnp.int32(nk), kind).astype(jnp.int32)
+    oh = jax.nn.one_hot(k_eff, N_KIND_SLOTS, dtype=jnp.int32)  # [B, NK+1]
+    proposed = jnp.sum(oh, axis=0)
+    acc = jnp.where(is_xover, xo_replace, immediate | accepted_mut)
+    accepted = jnp.sum(oh * acc.astype(jnp.int32)[:, None], axis=0)
+
+    # Same reject-reason chain as CycleEvents (evolve/step.py): shared
+    # semantics so the recorder's aggregate counts and these counters
+    # can never disagree on what "invalid" means.
+    mut_reason = jnp.where(
+        ~mut_success, 1,
+        jnp.where(~jnp.isfinite(after_cost), 2,
+                  jnp.where(~anneal_ok, 3, 0)))
+    xo_reason = jnp.where(~xo_success, 1, jnp.where(xo_nan, 2, 0))
+    reason = jnp.where(
+        is_xover, xo_reason, jnp.where(immediate, 0, mut_reason)
+    ).astype(jnp.int32)
+    reject_reasons = jnp.sum(
+        jax.nn.one_hot(reason, N_REASONS, dtype=jnp.int32), axis=0)
+
+    inv = (
+        jnp.sum((needs_eval1 & ~jnp.isfinite(cost[:, 0])).astype(jnp.int32))
+        + jnp.sum((needs_eval2 & ~jnp.isfinite(cost[:, 1])).astype(jnp.int32))
+    )
+    cands = (jnp.sum(needs_eval1.astype(jnp.int32))
+             + jnp.sum(needs_eval2.astype(jnp.int32)))
+    return CycleTelemetry(
+        proposed=proposed,
+        accepted=accepted,
+        reject_reasons=reject_reasons,
+        candidates=cands,
+        invalid=inv,
+        eval_rows=jnp.int32(n_eval_rows),
+        eval_launches=jnp.int32(1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Member duplication stats (the dedup hit-rate counter)
+# ---------------------------------------------------------------------------
+
+# Fixed odd multipliers for 3 independent linear int32-wraparound hashes
+# (same technique as ops/fused_eval's dedup adjacency hash; collisions
+# over the 3x31-bit combined key are negligible at population scales —
+# telemetry-grade exactness). Module-level fixed-seed constant,
+# deterministic by construction — not search RNG.
+@functools.lru_cache(maxsize=8)
+def _dup_hash_consts(width: int) -> np.ndarray:
+    rng = np.random.default_rng(0x5C09E)  # graftlint: disable=GL002
+    return (rng.integers(1, 2**31, size=(3, width), dtype=np.int64)
+            .astype(np.int32) | 1)
+
+
+def member_dup_stats(trees) -> Tuple[jax.Array, jax.Array]:
+    """(rows, unique) over the member axes of a TreeBatch ([I, P, L] or
+    template [I, P, K, L]): how many member rows are structurally
+    identical copies (constants included). This is the duplication the
+    fused dedup eval exploits at finalize (profiling/dup_rate.py
+    measured ~50% at the bench config); ``rows - unique`` = dedup hits.
+
+    Cost: two tiny [N] int32 hash reductions + one ``lax.sort`` of three
+    [N] keys — noise next to the finalize eval itself. Hash-only count:
+    a 93-bit collision would undercount uniques by 1; acceptable for a
+    telemetry counter (the dedup kernel itself verifies exactly).
+    """
+    L = trees.arity.shape[-1]
+    I, P = trees.arity.shape[0], trees.arity.shape[1]
+    N = I * P
+    lane = jnp.arange(L) < trees.length[..., None]
+    word = jnp.where(
+        lane,
+        (trees.arity.astype(jnp.int32) << 28)
+        ^ (trees.op.astype(jnp.int32) << 20)
+        ^ (trees.feat.astype(jnp.int32) << 8),
+        0,
+    )
+    cbits = jnp.where(
+        lane,
+        jax.lax.bitcast_convert_type(
+            trees.const.astype(jnp.float32), jnp.int32),
+        0,
+    )
+    word2 = word.reshape(N, -1)
+    cbits2 = cbits.reshape(N, -1)
+    W = word2.shape[1]
+    R = jnp.asarray(_dup_hash_consts(2 * W))
+    keys = [
+        jnp.sum(word2 * R[k, :W][None, :]
+                + cbits2 * R[k, W:][None, :], axis=1)
+        for k in range(3)
+    ]
+    sorted_keys = jax.lax.sort(keys, dimension=0, num_keys=3)
+    prev = lambda x: jnp.concatenate([x[:1], x[:-1]])
+    differs = jnp.zeros((N,), jnp.bool_)
+    for k in sorted_keys:
+        differs = differs | (k != prev(k))
+    unique = jnp.int32(1) + jnp.sum(differs.astype(jnp.int32))
+    return jnp.int32(N), unique
+
+
+def loss_histogram(loss: jax.Array) -> jax.Array:
+    """[LOSS_HIST_BINS] int32 histogram of log10(loss) over finite
+    population losses (finite losses <= 0 land in bin 0)."""
+    flat = loss.reshape(-1)
+    finite = jnp.isfinite(flat)
+    lg = jnp.log10(jnp.maximum(jnp.where(finite, flat, 1.0), 1e-30))
+    idx = jnp.clip(
+        ((lg - LOSS_HIST_LO)
+         / (LOSS_HIST_HI - LOSS_HIST_LO) * LOSS_HIST_BINS).astype(jnp.int32),
+        0, LOSS_HIST_BINS - 1,
+    )
+    oh = jax.nn.one_hot(idx, LOSS_HIST_BINS, dtype=jnp.int32)
+    return jnp.sum(oh * finite.astype(jnp.int32)[:, None], axis=0)

@@ -1,0 +1,272 @@
+"""graftscope run-report CLI.
+
+Summarizes a graftscope.v1 JSONL run file (see :mod:`.schema` and
+docs/OBSERVABILITY.md)::
+
+    python -m symbolicregression_jl_tpu.telemetry report run.jsonl
+    python -m symbolicregression_jl_tpu.telemetry report run.jsonl --json
+    python -m symbolicregression_jl_tpu.telemetry validate run.jsonl
+
+``report`` refuses files that fail schema validation (run ``validate``
+for the full violation list). ``--json`` emits the machine-readable
+summary dict instead of the human-readable text. Pure host-side JSON
+processing — no accelerator or jax session is touched.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from .schema import SCHEMA_VERSION, load_events, validate_lines
+
+__all__ = ["summarize", "format_report", "main"]
+
+
+def _mean(xs: List[float]) -> Optional[float]:
+    return sum(xs) / len(xs) if xs else None
+
+
+def _rate(num: int, den: int) -> Optional[float]:
+    return num / den if den else None
+
+
+def summarize(events: List[dict]) -> Dict[str, Any]:
+    """Machine-readable summary of a validated event list."""
+    run_start = next((e for e in events if e["event"] == "run_start"), None)
+    run_end = next((e for e in events if e["event"] == "run_end"), None)
+    iters = [e for e in events if e["event"] == "iteration"]
+
+    summary: Dict[str, Any] = {"schema": SCHEMA_VERSION}
+    if run_start is not None:
+        summary["run"] = {
+            k: run_start.get(k)
+            for k in ("run_id", "backend", "n_devices", "nout",
+                      "niterations", "telemetry_interval")
+        }
+        summary["run"]["options"] = run_start.get("options", {})
+        summary["run"]["engines"] = run_start.get("engines", [])
+
+    evals_curve = [[e["iteration"], e["evals_per_sec"]] for e in iters]
+    host_fracs = [e["host_fraction"] for e in iters]
+    recompile_traces = sum(e["recompiles"]["traces"] for e in iters)
+    recompile_backend = sum(e["recompiles"]["backend_compiles"] for e in iters)
+    warm = [e for e in iters[1:] if e["recompiles"]["traces"] == 0]
+    summary["iterations"] = {
+        "count": len(iters),
+        "evals_per_sec": {
+            "curve": evals_curve,
+            "final": evals_curve[-1][1] if evals_curve else None,
+            "peak": max((v for _, v in evals_curve), default=None),
+        },
+        "host_fraction": {
+            "mean": _mean(host_fracs),
+            "max": max(host_fracs, default=None),
+            "final": host_fracs[-1] if host_fracs else None,
+        },
+        "recompiles": {
+            "traces": recompile_traces,
+            "backend_compiles": recompile_backend,
+            "warm_iterations": len(warm),
+        },
+        "transfer_guard_hits": sum(
+            e.get("transfer_guard_hits", 0) for e in iters
+        ),
+    }
+
+    # Per-output aggregates across every iteration event that carried
+    # counters (intervals already sum within each event).
+    nout = max((len(e["outputs"]) for e in iters), default=0)
+    outputs = []
+    for j in range(nout):
+        outs = [e["outputs"][j] for e in iters if len(e["outputs"]) > j]
+        counters = [o["counters"] for o in outs if o.get("counters")]
+        agg: Dict[str, Any] = {
+            "output": j + 1,
+            "pareto_volume_curve": [
+                [e["iteration"], e["outputs"][j]["pareto_volume"]]
+                for e in iters if len(e["outputs"]) > j
+            ],
+            "final_min_loss": outs[-1]["min_loss"] if outs else None,
+        }
+        if counters:
+            kinds = sorted(
+                {k for c in counters for k in c["proposed"]}
+            )
+            proposed = {
+                k: sum(c["proposed"].get(k, 0) for c in counters)
+                for k in kinds
+            }
+            accepted = {
+                k: sum(c["accepted"].get(k, 0) for c in counters)
+                for k in kinds
+            }
+            agg["proposed"] = proposed
+            agg["accepted"] = accepted
+            agg["acceptance_rate"] = {
+                k: _rate(accepted[k], proposed[k])
+                for k in kinds if proposed[k]
+            }
+            agg["reject_reasons"] = {
+                r: sum(c["reject_reasons"].get(r, 0) for c in counters)
+                for r in sorted(
+                    {r for c in counters for r in c["reject_reasons"]}
+                )
+            }
+            cands = sum(c["candidates"] for c in counters)
+            agg["candidates"] = cands
+            agg["invalid_fraction"] = _rate(
+                sum(c["invalid"] for c in counters), cands
+            )
+            agg["eval_rows"] = sum(c["eval_rows"] for c in counters)
+            agg["eval_launches"] = sum(c["eval_launches"] for c in counters)
+            dedup_rows = sum(c["dedup"]["rows"] for c in counters)
+            agg["dedup_hit_rate"] = _rate(
+                sum(c["dedup"]["hits"] for c in counters), dedup_rows
+            )
+        outputs.append(agg)
+    summary["outputs"] = outputs
+
+    if run_end is not None:
+        summary["end"] = {
+            k: run_end.get(k)
+            for k in ("stop_reason", "iterations", "num_evals", "elapsed_s",
+                      "recompiles_total")
+        }
+    return summary
+
+
+def _fmt_pct(x: Optional[float]) -> str:
+    return "-" if x is None else f"{100.0 * x:.1f}%"
+
+
+def _fmt_num(x: Optional[float]) -> str:
+    if x is None:
+        return "-"
+    return f"{x:,.3g}" if isinstance(x, float) else f"{x:,}"
+
+
+def format_report(summary: Dict[str, Any]) -> str:
+    """Human-readable text report."""
+    lines: List[str] = []
+    run = summary.get("run", {})
+    if run:
+        lines.append(
+            f"run {run.get('run_id')}  [{run.get('backend')} x "
+            f"{run.get('n_devices')} device(s), nout={run.get('nout')}, "
+            f"interval={run.get('telemetry_interval')}]"
+        )
+    it = summary["iterations"]
+    eps = it["evals_per_sec"]
+    lines.append(
+        f"iterations: {it['count']} events  |  evals/s final "
+        f"{_fmt_num(eps['final'])}, peak {_fmt_num(eps['peak'])}"
+    )
+    curve = eps["curve"]
+    if len(curve) > 1:
+        pts = ", ".join(f"{i}:{_fmt_num(v)}" for i, v in curve[:12])
+        more = "" if len(curve) <= 12 else f", ... +{len(curve) - 12}"
+        lines.append(f"  evals/s trajectory: {pts}{more}")
+    hf = it["host_fraction"]
+    lines.append(
+        f"host-fraction: mean {_fmt_pct(hf['mean'])}, max "
+        f"{_fmt_pct(hf['max'])}, final {_fmt_pct(hf['final'])}"
+    )
+    rc = it["recompiles"]
+    lines.append(
+        f"recompiles: {rc['traces']} traces / {rc['backend_compiles']} "
+        f"backend compiles across iteration events "
+        f"({rc['warm_iterations']} warm iterations); "
+        f"{it['transfer_guard_hits']} transfer-guard hits"
+    )
+    for out in summary["outputs"]:
+        lines.append(f"output {out['output']}:")
+        pv = out["pareto_volume_curve"]
+        if pv:
+            pts = ", ".join(f"{i}:{v:.3g}" for i, v in pv[:12])
+            more = "" if len(pv) <= 12 else f", ... +{len(pv) - 12}"
+            lines.append(f"  pareto volume: {pts}{more}")
+        if out.get("final_min_loss") is not None:
+            lines.append(f"  final min loss: {out['final_min_loss']:.6g}")
+        if "acceptance_rate" in out:
+            rates = sorted(
+                out["acceptance_rate"].items(), key=lambda kv: -kv[1]
+            )
+            lines.append("  acceptance by kind (accepted/proposed):")
+            for k, r in rates:
+                lines.append(
+                    f"    {k:<18} {out['accepted'][k]:>8,} / "
+                    f"{out['proposed'][k]:>8,}  ({_fmt_pct(r)})"
+                )
+            lines.append(
+                f"  candidates: {_fmt_num(out['candidates'])}  "
+                f"(invalid {_fmt_pct(out['invalid_fraction'])})  |  "
+                f"eval rows {_fmt_num(out['eval_rows'])} in "
+                f"{_fmt_num(out['eval_launches'])} launches  |  "
+                f"dedup hit-rate {_fmt_pct(out['dedup_hit_rate'])}"
+            )
+            rej = out.get("reject_reasons", {})
+            if rej:
+                lines.append(
+                    "  reject reasons: "
+                    + ", ".join(f"{k}={v:,}" for k, v in rej.items())
+                )
+    end = summary.get("end")
+    if end:
+        lines.append(
+            f"run end: {end.get('stop_reason')} after "
+            f"{end.get('iterations')} iterations, "
+            f"{_fmt_num(end.get('num_evals'))} evals in "
+            f"{_fmt_num(end.get('elapsed_s'))}s; lifetime compiles "
+            f"{end.get('recompiles_total')}"
+        )
+    return "\n".join(lines)
+
+
+_USAGE = """usage: python -m symbolicregression_jl_tpu.telemetry <cmd> <run.jsonl>
+
+commands:
+  report <run.jsonl> [--json]   summarize a run (refuses invalid files)
+  validate <run.jsonl>          check every line against graftscope.v1
+"""
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(_USAGE, end="")
+        return 0 if argv else 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "validate":
+        if len(rest) != 1:
+            print(_USAGE, end="", file=sys.stderr)
+            return 2
+        with open(rest[0]) as f:
+            errors = validate_lines(f.readlines())
+        if errors:
+            for e in errors:
+                print(e, file=sys.stderr)
+            print(f"{rest[0]}: {len(errors)} violation(s)", file=sys.stderr)
+            return 1
+        print(f"{rest[0]}: valid {SCHEMA_VERSION}")
+        return 0
+    if cmd == "report":
+        as_json = "--json" in rest
+        paths = [a for a in rest if not a.startswith("-")]
+        if len(paths) != 1:
+            print(_USAGE, end="", file=sys.stderr)
+            return 2
+        try:
+            events = load_events(paths[0])
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        summary = summarize(events)
+        if as_json:
+            print(json.dumps(summary))
+        else:
+            print(format_report(summary))
+        return 0
+    print(_USAGE, end="", file=sys.stderr)
+    return 2

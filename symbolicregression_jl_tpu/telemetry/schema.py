@@ -1,0 +1,183 @@
+"""graftscope JSONL event schema (version 1) + hand-rolled validator.
+
+Every line the `Telemetry` hub emits is one JSON object with at least::
+
+    {"schema": "graftscope.v1", "event": <type>, "t": <unix seconds>}
+
+Event types and their required fields are listed in :data:`EVENT_SPECS`.
+No external jsonschema dependency: the validator is a small table-driven
+checker (CI validates every emitted line with it, and the report CLI
+refuses files that don't validate — see docs/OBSERVABILITY.md for the
+full field semantics).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Tuple
+
+__all__ = ["SCHEMA_VERSION", "EVENT_SPECS", "validate_event",
+           "validate_lines", "load_events"]
+
+SCHEMA_VERSION = "graftscope.v1"
+
+_NUM = (int, float)
+
+# event type -> {field: type or tuple of types}. Fields may hold None
+# where noted ("nullable" set). Unknown extra fields are allowed (the
+# schema is forward-extensible; v2 consumers must ignore them too).
+EVENT_SPECS: Dict[str, Dict[str, Any]] = {
+    "run_start": {
+        "run_id": str,
+        "backend": str,
+        "n_devices": int,
+        "nout": int,
+        "niterations": int,
+        "telemetry_interval": int,
+        "options": dict,
+        "engines": list,
+    },
+    "iteration": {
+        "iteration": int,
+        "num_evals": _NUM,
+        "evals_per_sec": _NUM,
+        "elapsed_s": _NUM,
+        "device_s": _NUM,
+        "host_s": _NUM,
+        "host_fraction": _NUM,
+        "recompiles": dict,
+        "transfer_guard_hits": int,
+        "outputs": list,
+    },
+    "run_end": {
+        "stop_reason": str,
+        "iterations": int,
+        "num_evals": _NUM,
+        "elapsed_s": _NUM,
+        "recompiles_total": dict,
+    },
+}
+
+# required keys inside each element of iteration.outputs; nullable
+# fields are expressed as (type, type(None)) tuples
+_OUTPUT_FIELDS: Dict[str, Any] = {
+    "output": int,
+    "min_loss": (_NUM, type(None)),
+    "pareto_volume": _NUM,
+    "counters": (dict, type(None)),
+    "loss_hist": (list, type(None)),
+    "complexity_hist": (list, type(None)),
+}
+
+# required keys inside iteration.outputs[*].counters when present
+_COUNTER_FIELDS: Dict[str, Any] = {
+    "proposed": dict,
+    "accepted": dict,
+    "reject_reasons": dict,
+    "candidates": int,
+    "invalid": int,
+    "eval_rows": int,
+    "eval_launches": int,
+    "dedup": dict,
+}
+
+
+def _type_ok(value, spec) -> bool:
+    if isinstance(spec, tuple):
+        flat: Tuple[type, ...] = ()
+        for s in spec:
+            flat += s if isinstance(s, tuple) else (s,)
+        spec = flat
+    ok = isinstance(value, spec)
+    # bool is an int subclass; reject it where a number is expected
+    if ok and isinstance(value, bool) and not (
+        spec is bool or (isinstance(spec, tuple) and bool in spec)
+    ):
+        return False
+    return ok
+
+
+def _check_fields(obj: dict, fields: Dict[str, Any], where: str,
+                  errors: List[str]) -> None:
+    for name, spec in fields.items():
+        if name not in obj:
+            errors.append(f"{where}: missing field {name!r}")
+        elif not _type_ok(obj[name], spec):
+            errors.append(
+                f"{where}: field {name!r} has type "
+                f"{type(obj[name]).__name__}, expected {spec}"
+            )
+
+
+def validate_event(obj: Any) -> List[str]:
+    """Validate one decoded JSONL event; return violation strings
+    (empty list = valid)."""
+    errors: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"event is {type(obj).__name__}, expected object"]
+    if obj.get("schema") != SCHEMA_VERSION:
+        errors.append(
+            f"schema is {obj.get('schema')!r}, expected {SCHEMA_VERSION!r}"
+        )
+    ev = obj.get("event")
+    if ev not in EVENT_SPECS:
+        errors.append(
+            f"event type {ev!r} not one of {sorted(EVENT_SPECS)}"
+        )
+        return errors
+    if not _type_ok(obj.get("t"), _NUM):
+        errors.append(f"{ev}: field 't' must be a unix timestamp")
+    _check_fields(obj, EVENT_SPECS[ev], ev, errors)
+    if ev == "iteration" and isinstance(obj.get("outputs"), list):
+        for i, out in enumerate(obj["outputs"]):
+            where = f"iteration.outputs[{i}]"
+            if not isinstance(out, dict):
+                errors.append(f"{where}: not an object")
+                continue
+            _check_fields(out, _OUTPUT_FIELDS, where, errors)
+            counters = out.get("counters")
+            if isinstance(counters, dict):
+                _check_fields(
+                    counters, _COUNTER_FIELDS, where + ".counters", errors
+                )
+    if ev == "iteration" and isinstance(obj.get("recompiles"), dict):
+        for k in ("traces", "backend_compiles"):
+            if not isinstance(obj["recompiles"].get(k), int):
+                errors.append(f"iteration.recompiles.{k}: missing/not int")
+    return errors
+
+
+def validate_lines(lines: Iterable[str]) -> List[str]:
+    """Validate raw JSONL lines; returns one violation string per
+    problem, prefixed with the 1-based line number."""
+    errors: List[str] = []
+    n = 0
+    for lineno, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        n += 1
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"line {lineno}: invalid JSON ({e})")
+            continue
+        errors.extend(f"line {lineno}: {m}" for m in validate_event(obj))
+    if n == 0:
+        errors.append("no events found (empty file)")
+    return errors
+
+
+def load_events(path: str) -> List[dict]:
+    """Load + validate a JSONL run file; raises ValueError with the full
+    violation list on any schema problem."""
+    with open(path) as f:
+        lines = f.readlines()
+    errors = validate_lines(lines)
+    if errors:
+        raise ValueError(
+            f"{path} failed {SCHEMA_VERSION} validation:\n  "
+            + "\n  ".join(errors[:20])
+            + ("" if len(errors) <= 20 else f"\n  ... +{len(errors) - 20} more")
+        )
+    return [json.loads(l) for l in lines if l.strip()]

@@ -1,0 +1,46 @@
+"""Span tracing: line a profiler capture up with search iterations.
+
+Thin wrappers over ``jax.profiler``'s trace annotations, named so a
+perfetto / xplane capture of a search shows one ``sr:iteration`` step
+per engine iteration with the host phases (hall-of-fame decode,
+checkpoint CSV writes, telemetry sinks/logging) as named spans between
+device steps. Annotations are no-ops (nanoseconds of overhead) when no
+trace is being captured, so they are ALWAYS on — no option gates them.
+
+Span names (schema-stable, see docs/OBSERVABILITY.md):
+
+- ``sr:iteration`` — ``StepTraceAnnotation`` per search iteration
+  (device launches + the blocking sync), carrying ``step_num``.
+- ``sr:host:hof_decode`` — device HoF pull + host tree decode.
+- ``sr:host:checkpoint`` — hall-of-fame CSV + full-state pickle writes.
+- ``sr:host:sinks`` — telemetry hub sink dispatch (SRLogger, Recorder,
+  ProgressBar, JSONL emission).
+- ``sr:host:report`` — regressor report building (pareto scoring,
+  equation stringification).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["step_span", "host_span"]
+
+
+def step_span(step_num: int):
+    """Profiler step annotation for one search iteration."""
+    try:
+        import jax.profiler as _prof
+
+        return _prof.StepTraceAnnotation("sr:iteration", step_num=step_num)
+    except Exception:  # pragma: no cover - profiler unavailable
+        return contextlib.nullcontext()
+
+
+def host_span(name: str):
+    """Named host-phase span (``sr:host:<name>``)."""
+    try:
+        import jax.profiler as _prof
+
+        return _prof.TraceAnnotation(f"sr:host:{name}")
+    except Exception:  # pragma: no cover - profiler unavailable
+        return contextlib.nullcontext()

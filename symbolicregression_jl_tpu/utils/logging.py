@@ -96,7 +96,7 @@ class SRLogger:
     _count: int = 0
 
     def log_iteration(self, *, iteration, hofs, states, options, num_evals,
-                      elapsed) -> None:
+                      elapsed, host_fraction: Optional[float] = None) -> None:
         self._count += 1
         if self._count % max(self.log_interval, 1) != 0:
             return
@@ -107,6 +107,11 @@ class SRLogger:
             "evals_per_sec": float(num_evals) / max(float(elapsed), 1e-9),
             "outputs": [],
         }
+        if host_fraction is not None:
+            # Host-pacing share of loop time (ResourceMonitor) — the
+            # telemetry hub passes it so logger backends can alert on
+            # host-bound searches without scraping stdout.
+            payload["host_fraction"] = float(host_fraction)
         for j, (hof, state) in enumerate(zip(hofs, states)):
             frontier = hof.pareto_frontier()
             losses = [e.loss for e in frontier]

@@ -38,12 +38,20 @@ class ResourceMonitor:
         return host / total if total > 0 else 0.0
 
     def check_and_warn(self, verbosity: int = 1) -> bool:
-        """One-shot warning when host overhead paces the search
-        (the reference warns at 10s head occupancy estimates >= ~0.X)."""
-        if self._warned or len(self.samples) < self.samples.maxlen:
+        """Warn when host overhead paces the search (the reference warns
+        at 10s head occupancy estimates >= ~0.X).
+
+        The warning is edge-triggered, not one-shot: it re-arms when the
+        fraction drops back below the threshold (with a recovery note),
+        so a host-overhead regression AFTER a recovery is not silent —
+        the old latch never reset and swallowed every later excursion.
+        """
+        if len(self.samples) < self.samples.maxlen:
             return False
         frac = self.estimate_work_fraction()
         if frac > self.warn_fraction:
+            if self._warned:
+                return False
             self._warned = True
             if verbosity >= 1:
                 print(
@@ -52,4 +60,10 @@ class ResourceMonitor:
                     "reducing verbosity."
                 )
             return True
+        if self._warned:
+            self._warned = False  # re-arm for the next excursion
+            if verbosity >= 1:
+                print(
+                    f"Host bookkeeping recovered to {frac:.0%} of loop time."
+                )
         return False

@@ -32,16 +32,18 @@ class ProgressBar:
         self.count = 0
 
     def update(self, count: int, best_loss: float = float("nan"),
-               evals_per_sec: float = float("nan")) -> None:
+               evals_per_sec: float = float("nan"),
+               host_fraction: Optional[float] = None) -> None:
         self.count = count
         frac = min(count / self.total, 1.0)
         filled = int(frac * self.width)
         bar = "█" * filled + "░" * (self.width - filled)
         elapsed = time.time() - self.start
         eta = elapsed / frac - elapsed if frac > 0 else float("inf")
+        host = "" if host_fraction is None else f"  host {host_fraction:.0%}"
         postfix = (
             f"best_loss={best_loss:.4g}  {evals_per_sec:,.0f} evals/s  "
-            f"eta {eta:,.0f}s"
+            f"eta {eta:,.0f}s{host}"
         )
         self.stream.write(f"\r{bar} {count}/{self.total}  {postfix}   ")
         self.stream.flush()

@@ -39,10 +39,25 @@ def _sanitize(v):
 
 
 class Recorder:
-    """Accumulates RecordType-style nested dicts (src/ProgramConstants.jl)."""
+    """Accumulates RecordType-style nested dicts (src/ProgramConstants.jl).
 
-    def __init__(self, options) -> None:
+    ``stream_path``: when given AND ``recorder_verbosity >= 2`` (the
+    per-event rejection mode, whose host dicts dominate memory — see
+    ``_assemble_events``), each iteration's record is spilled to that
+    path as one JSONL line the moment it is assembled, instead of
+    holding every iteration in memory until teardown; ``write()`` merges
+    the spilled stream back so the on-disk JSON layout is identical to
+    the in-memory path, and removes the stream file.
+    """
+
+    def __init__(self, options, stream_path: Optional[str] = None) -> None:
         self.verbosity = int(getattr(options, "recorder_verbosity", 1))
+        self._stream_path = stream_path if self.verbosity >= 2 else None
+        if self._stream_path is not None:
+            d = os.path.dirname(self._stream_path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            open(self._stream_path, "w").close()  # truncate stale stream
         self.record: Dict[str, Any] = {
             "options": repr(options),
             "iterations": [],
@@ -81,25 +96,31 @@ class Recorder:
         event_log = None
         if events is not None:
             event_log = self._assemble_events(events)
-        self.record["iterations"].append(
-            {
-                "iteration": iteration,
-                "out": out_idx + 1,
-                "num_evals": float(num_evals),
-                "events": event_log,
-                "islands": islands,
-                "hall_of_fame": [
-                    {
-                        "complexity": int(e.complexity),
-                        "loss": _sanitize(float(e.loss)),
-                        "equation": e.equation_string(
-                            variable_names=variable_names
-                        ),
-                    }
-                    for e in hof.entries
-                ],
-            }
-        )
+        rec = {
+            "iteration": iteration,
+            "out": out_idx + 1,
+            "num_evals": float(num_evals),
+            "events": event_log,
+            "islands": islands,
+            "hall_of_fame": [
+                {
+                    "complexity": int(e.complexity),
+                    "loss": _sanitize(float(e.loss)),
+                    "equation": e.equation_string(
+                        variable_names=variable_names
+                    ),
+                }
+                for e in hof.entries
+            ],
+        }
+        if self._stream_path is not None:
+            # Spill now, free now: verbosity-2 event logs are ~2M dicts
+            # per iteration at the bench config; holding a whole run's
+            # worth until write() was the memory cliff.
+            with open(self._stream_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        else:
+            self.record["iterations"].append(rec)
 
     _REASONS = ("none", "constraint", "invalid", "annealing")
 
@@ -189,5 +210,30 @@ class Recorder:
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(self.record, f)
+        if self._stream_path is None or not os.path.exists(self._stream_path):
+            with open(path, "w") as f:
+                json.dump(self.record, f)
+            return
+        # End-of-run merge: splice the spilled per-iteration records
+        # (in arrival order, already serialized JSON objects) straight
+        # into the output's "iterations" array line by line — loading
+        # them all back first would re-materialize the exact event-dict
+        # volume the streaming exists to cap. Same JSON layout as the
+        # in-memory path (json.dump default separators).
+        with open(path, "w") as out:
+            out.write('{"options": ' + json.dumps(self.record["options"])
+                      + ', "iterations": [')
+            first = True
+            with open(self._stream_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    out.write(("" if first else ", ") + line)
+                    first = False
+            for rec in self.record["iterations"]:
+                out.write(("" if first else ", ") + json.dumps(rec))
+                first = False
+            out.write('], "final_state": '
+                      + json.dumps(self.record["final_state"]) + "}")
+        os.remove(self._stream_path)

@@ -30,12 +30,17 @@ from symbolicregression_jl_tpu.lint.runtime import (
 )
 
 
-@pytest.fixture(scope="module", params=["jnp", "turbo-fused"])
+@pytest.fixture(scope="module",
+                params=["jnp", "turbo-fused", "turbo-telemetry"])
 def engine_and_state(request):
     # "turbo-fused" pins the round-6 hot path: the fused Pallas eval
     # with the in-kernel cost epilogue (interpret mode off-TPU) must be
     # exactly as trace- and transfer-free as the jnp fallback.
-    turbo = request.param == "turbo-fused"
+    # "turbo-telemetry" additionally turns on the graftscope device
+    # counters (round 7): the accumulators ride the scan carry and the
+    # engine state, so a warm iteration must STILL show 0 traces and 0
+    # implicit transfers with them enabled.
+    turbo = request.param != "jnp"
     opts = Options(
         binary_operators=["+", "*"],
         unary_operators=["cos"],
@@ -47,6 +52,7 @@ def engine_and_state(request):
         save_to_file=False,
         debug_checks=True,  # postfix-invariant audit on warm-up output
         turbo=turbo,
+        telemetry=request.param == "turbo-telemetry",
     )
     rng = np.random.default_rng(0)
     X = rng.uniform(-2, 2, (64, 2)).astype(np.float32)

@@ -1,0 +1,115 @@
+"""SRLogger geometry: convex_hull / pareto_volume on degenerate inputs
+plus a golden-value check against a hand-computed hull
+(src/Logging.jl:157-215 analogues)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.utils.logging import convex_hull, pareto_volume
+
+
+def _shoelace(pts):
+    area = 0.0
+    n = len(pts)
+    for i in range(n):
+        x1, y1 = pts[i]
+        x2, y2 = pts[(i + 1) % n]
+        area += x1 * y2 - x2 * y1
+    return abs(area) / 2.0
+
+
+# ---------------------------------------------------------------------------
+# convex_hull
+# ---------------------------------------------------------------------------
+
+
+def test_hull_fewer_than_three_points_returned_verbatim():
+    one = np.array([[1.0, 2.0]])
+    np.testing.assert_array_equal(convex_hull(one), one)
+    two = np.array([[0.0, 0.0], [1.0, 1.0]])
+    np.testing.assert_array_equal(convex_hull(two), two)
+
+
+def test_hull_golden_square_with_interior_and_duplicate_points():
+    """Hand-computed golden: the hull of a unit square + an interior
+    point + a duplicated corner is exactly the four corners, area 1."""
+    pts = np.array([
+        [0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0],
+        [0.5, 0.5],              # interior: must not be on the hull
+        [0.0, 0.0],              # duplicate corner: must not break it
+    ])
+    hull = convex_hull(pts)
+    corners = {(0.0, 0.0), (1.0, 0.0), (1.0, 1.0), (0.0, 1.0)}
+    assert {tuple(p) for p in hull} == corners
+    assert _shoelace(hull) == pytest.approx(1.0)
+
+
+def test_hull_collinear_points_terminate():
+    pts = np.array([[0.0, 0.0], [1.0, 1.0], [2.0, 2.0]])
+    hull = convex_hull(pts)
+    # gift wrapping keeps collinear points but must terminate with a
+    # zero-area (degenerate) polygon
+    assert 2 <= hull.shape[0] <= 3
+    assert _shoelace(hull) == pytest.approx(0.0)
+
+
+def test_hull_all_identical_points_terminate():
+    pts = np.tile(np.array([[3.0, -1.0]]), (5, 1))
+    hull = convex_hull(pts)
+    assert hull.shape[1] == 2
+    assert _shoelace(hull) == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------------
+# pareto_volume
+# ---------------------------------------------------------------------------
+
+
+def test_pareto_volume_golden_two_point_front():
+    """Hand-computed: losses [1, 0.1] at complexities [1, 3], maxsize 7.
+
+    In (log10 cx, log10 loss) space the front is (0, 0) -> (log10 3, -1);
+    the closure adds (log10 8, 0) and (0, 0) [min-x at max-y], so the
+    hull is the triangle (0,0), (log10 3, -1), (log10 8, 0) with area
+    log10(8) * 1 / 2.
+    """
+    vol = pareto_volume([1.0, 0.1], [1, 3], maxsize=7)
+    assert vol == pytest.approx(math.log10(8.0) / 2.0, rel=1e-9)
+
+
+def test_pareto_volume_empty_and_nonpositive():
+    assert pareto_volume([], [], maxsize=10) == 0.0
+    # log scaling drops non-positive losses entirely
+    assert pareto_volume([0.0, -1.0], [1, 2], maxsize=10) == 0.0
+    # inf / nan losses are filtered, not propagated
+    assert pareto_volume([np.inf, np.nan], [1, 2], maxsize=10) == 0.0
+
+
+def test_pareto_volume_single_point_is_finite():
+    vol = pareto_volume([1.0], [1], maxsize=7)
+    # degenerate y-range is widened by 1 decade: triangle
+    # (0,0)-(log10 8, 1)-(0, 1), area log10(8)/2
+    assert vol == pytest.approx(math.log10(8.0) / 2.0, rel=1e-9)
+    assert np.isfinite(vol)
+
+
+def test_pareto_volume_single_complexity_front():
+    # duplicate complexities: the front collapses to one x; volume is the
+    # closure triangle, finite and positive
+    vol = pareto_volume([1.0, 0.5], [2, 2], maxsize=7)
+    expected = (math.log10(8.0) - math.log10(2.0)) * math.log10(2.0) / 2.0
+    assert vol == pytest.approx(expected, rel=1e-9)
+
+
+def test_pareto_volume_duplicate_points_match_unique():
+    a = pareto_volume([1.0, 0.1, 0.1], [1, 3, 3], maxsize=7)
+    b = pareto_volume([1.0, 0.1], [1, 3], maxsize=7)
+    assert a == pytest.approx(b, rel=1e-9)
+
+
+def test_pareto_volume_linear_scaling_keeps_nonpositive():
+    vol = pareto_volume([1.0, 0.0], [1, 3], maxsize=7,
+                        use_linear_scaling=True)
+    assert np.isfinite(vol) and vol > 0.0

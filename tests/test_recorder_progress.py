@@ -159,6 +159,12 @@ def test_recorder_verbosity2_rejection_events(tmp_path):
     # same seed, same search: verbosity only changes the log detail
     accs = ev_block["accepted"]
     assert len(accs) > 10
+    # verbosity-2 iteration records stream to <recorder_file>.stream as
+    # they are assembled (memory cap); write() merges them back into the
+    # reference layout (asserted above) and removes the spill file
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "evrun2", "rec.json.stream")
+    )
 
 
 def test_progress_bar_smoke(tmp_path, capsys):
@@ -174,7 +180,7 @@ def test_progress_bar_smoke(tmp_path, capsys):
 
 def test_resource_monitor_fraction_and_warning(capsys):
     """ResourceMonitor analogue (src/SearchUtils.jl:411-438): host
-    fraction estimate and the one-shot pacing warning."""
+    fraction estimate and the edge-triggered pacing warning."""
     from symbolicregression_jl_tpu.utils.monitor import ResourceMonitor
 
     m = ResourceMonitor(window=4, warn_fraction=0.2)
@@ -183,10 +189,31 @@ def test_resource_monitor_fraction_and_warning(capsys):
     assert abs(m.estimate_work_fraction() - 0.5) < 1e-9
     assert m.check_and_warn(verbosity=1)
     assert "host bookkeeping" in capsys.readouterr().out
-    # one-shot: does not warn twice
+    # edge-triggered: does not warn twice while still over threshold
     assert not m.check_and_warn(verbosity=1)
 
     fast = ResourceMonitor(window=2, warn_fraction=0.2)
     fast.record(1.0, 0.01)
     fast.record(1.0, 0.01)
     assert not fast.check_and_warn(verbosity=0)
+
+
+def test_resource_monitor_rearms_after_recovery(capsys):
+    """A host-overhead regression AFTER a recovery must warn again —
+    the old one-shot latch never reset (silent regression)."""
+    from symbolicregression_jl_tpu.utils.monitor import ResourceMonitor
+
+    m = ResourceMonitor(window=2, warn_fraction=0.2)
+    m.record(1.0, 1.0)
+    m.record(1.0, 1.0)
+    assert m.check_and_warn(verbosity=1)          # first excursion warns
+    assert not m.check_and_warn(verbosity=1)      # latched while high
+    capsys.readouterr()
+    m.record(1.0, 0.01)
+    m.record(1.0, 0.01)
+    assert not m.check_and_warn(verbosity=1)      # recovered: re-arms
+    assert "recovered" in capsys.readouterr().out
+    m.record(1.0, 1.0)
+    m.record(1.0, 1.0)
+    assert m.check_and_warn(verbosity=1)          # regression warns AGAIN
+    assert "host bookkeeping" in capsys.readouterr().out

@@ -1,0 +1,283 @@
+"""graftscope telemetry: device counters, JSONL hub, report CLI.
+
+Pins the three contracts docs/OBSERVABILITY.md promises:
+
+- counter identities — the in-graph accumulators agree with the static
+  launch arithmetic of the evolve cycle (proposed slots, eval rows,
+  launch counts) and with each other (accepted <= proposed, invalid <=
+  candidates);
+- zero perturbation — a search with ``telemetry=True`` is bit-identical
+  to the same search with it off (the counters only read values the
+  step already computed);
+- the JSONL stream validates against graftscope.v1 and the report CLI
+  summarizes it without error.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from symbolicregression_jl_tpu import Options, equation_search, make_dataset
+from symbolicregression_jl_tpu.evolve.engine import Engine
+from symbolicregression_jl_tpu.telemetry.report import (
+    format_report,
+    main as report_main,
+    summarize,
+)
+from symbolicregression_jl_tpu.telemetry.schema import (
+    SCHEMA_VERSION,
+    validate_event,
+    validate_lines,
+)
+
+
+def _opts(**kw):
+    base = dict(
+        binary_operators=["+", "*"],
+        unary_operators=["cos"],
+        maxsize=10,
+        populations=2,
+        population_size=12,
+        tournament_selection_n=4,
+        ncycles_per_iteration=3,
+        save_to_file=False,
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _dataset():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (64, 2)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 1.0).astype(np.float32)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(Options().elementwise_loss)
+    return ds
+
+
+@pytest.fixture(scope="module")
+def telemetry_iteration():
+    """One engine iteration with counters on; returns (opts, eng, telem)."""
+    opts = _opts(telemetry=True)
+    ds = _dataset()
+    eng = Engine(opts, ds.nfeatures)
+    state = eng.init_state(jax.random.key(0), ds.data, 2)
+    state = eng.run_iteration(state, ds.data, jnp.int32(opts.maxsize))
+    return opts, eng, ds, jax.device_get(state.telem)
+
+
+def test_counter_identities(telemetry_iteration):
+    opts, eng, ds, t = telemetry_iteration
+    I = opts.populations
+    P = opts.population_size
+    B = eng.cfg.n_slots
+    C = opts.ncycles_per_iteration
+    # every slot proposes exactly once per cycle per island
+    assert int(t.cycle.proposed.sum()) == I * B * C
+    assert (np.asarray(t.cycle.accepted) <= np.asarray(t.cycle.proposed)).all()
+    # reject reasons partition the proposals
+    assert int(t.cycle.reject_reasons.sum()) == I * B * C
+    assert 0 <= int(t.cycle.invalid) <= int(t.cycle.candidates)
+    # one candidate-eval launch per island per cycle + the finalize
+    assert int(t.cycle.eval_launches) == I * C + 1
+    # in-cycle rows are static per step; finalize adds I*P
+    per_step = (int(t.cycle.eval_rows) - I * P) // (I * C)
+    assert per_step * I * C + I * P == int(t.cycle.eval_rows)
+    assert B <= per_step <= 2 * B
+    # finalize dup stats cover the whole member axis
+    assert int(t.finalize_rows) == I * P
+    assert 1 <= int(t.finalize_unique) <= I * P
+    # histograms cover at most the population (non-finite losses drop out)
+    assert int(t.loss_hist.sum()) <= I * P
+    assert int(t.cx_hist.sum()) <= I * P
+    assert t.cx_hist.shape == (opts.maxsize,)
+
+
+def test_chunked_iteration_same_counters(telemetry_iteration):
+    opts, eng, ds, t = telemetry_iteration
+    state = eng.init_state(jax.random.key(0), ds.data, 2)
+    state = eng.run_iteration(
+        state, ds.data, jnp.int32(opts.maxsize), chunk_sizes=[1, 1, 1]
+    )
+    t2 = jax.device_get(state.telem)
+    np.testing.assert_array_equal(
+        np.asarray(t.cycle.proposed), np.asarray(t2.cycle.proposed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(t.cycle.accepted), np.asarray(t2.cycle.accepted)
+    )
+    assert int(t.cycle.eval_rows) == int(t2.cycle.eval_rows)
+
+
+def test_search_bit_identical_with_telemetry_on_off():
+    """Acceptance pin: 2-iteration engine A/B produces bit-identical
+    HoF (and population) with telemetry on vs off."""
+    ds = _dataset()
+    cm = jnp.int32(10)
+    states = {}
+    for tel in (False, True):
+        eng = Engine(_opts(telemetry=tel), ds.nfeatures)
+        s = eng.init_state(jax.random.key(0), ds.data, 2)
+        s = eng.run_iteration(s, ds.data, cm)
+        s = eng.run_iteration(s, ds.data, cm)
+        states[tel] = s
+    for field in ("cost", "loss", "complexity"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(states[False].hof, field)),
+            np.asarray(getattr(states[True].hof, field)),
+        )
+    for field in ("arity", "op", "feat", "const", "length"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(states[False].hof.trees, field)),
+            np.asarray(getattr(states[True].hof.trees, field)),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(getattr(states[False].pops.trees, field)),
+            np.asarray(getattr(states[True].pops.trees, field)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# JSONL stream + CLI
+# ---------------------------------------------------------------------------
+
+
+def _run_search(tmp_path, run_id, niterations=2, **opt_kw):
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (64, 2)).astype(np.float32)
+    y = (X[:, 0] * 2.0 + X[:, 1]).astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=2,
+        tournament_selection_n=4,
+        optimizer_probability=0.0,
+        output_directory=str(tmp_path),
+        telemetry=True,
+        **opt_kw,
+    )
+    equation_search(
+        X, y, options=opts, niterations=niterations, verbosity=0,
+        run_id=run_id, seed=0,
+    )
+    return os.path.join(str(tmp_path), run_id, "telemetry.jsonl")
+
+
+def test_search_emits_valid_jsonl_and_report(tmp_path, capsys):
+    path = _run_search(tmp_path, "telrun")
+    with open(path) as f:
+        lines = f.readlines()
+    assert validate_lines(lines) == []
+    events = [json.loads(l) for l in lines]
+    assert [e["event"] for e in events] == [
+        "run_start", "iteration", "iteration", "run_end"
+    ]
+    assert events[0]["schema"] == SCHEMA_VERSION
+    assert events[0]["engines"][0]["collect_telemetry"] is True
+    it1 = events[1]
+    counters = it1["outputs"][0]["counters"]
+    # per-kind dicts name every mutation kind + crossover
+    from symbolicregression_jl_tpu.core.options import MUTATION_KINDS
+
+    assert set(counters["proposed"]) == set(MUTATION_KINDS) | {"crossover"}
+    assert sum(counters["proposed"].values()) == 2 * 2 * 2  # I * B * C
+    # under the conftest's 8-device virtual mesh the island axis shards,
+    # where dup stats are documented zeros; unsharded they cover I*P
+    shards = events[0]["engines"][0]["n_island_shards"]
+    assert counters["dedup"]["rows"] == (0 if shards > 1 else 2 * 8)
+    assert it1["outputs"][0]["complexity_hist"] is not None
+    assert events[3]["stop_reason"] == "niterations"
+
+    # CLI: validate + report + report --json all succeed on the file
+    assert report_main(["validate", path]) == 0
+    assert report_main(["report", path]) == 0
+    out = capsys.readouterr().out
+    assert "acceptance by kind" in out
+    assert "host-fraction" in out
+    assert report_main(["report", path, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["iterations"]["count"] == 2
+    assert summary["outputs"][0]["candidates"] > 0
+    assert summary["end"]["stop_reason"] == "niterations"
+
+
+def test_telemetry_interval_accumulates(tmp_path):
+    path = _run_search(
+        tmp_path, "telint", niterations=3, telemetry_interval=2
+    )
+    with open(path) as f:
+        events = [json.loads(l) for l in f if l.strip()]
+    iters = [e for e in events if e["event"] == "iteration"]
+    # emit at iteration 2 (interval) and 3 (end-of-run flush)
+    assert [e["iteration"] for e in iters] == [2, 3]
+    # first event carries BOTH iterations' counters summed
+    assert sum(iters[0]["outputs"][0]["counters"]["proposed"].values()) \
+        == 2 * (2 * 2 * 2)
+    assert sum(iters[1]["outputs"][0]["counters"]["proposed"].values()) \
+        == 2 * 2 * 2
+
+
+def test_validator_catches_malformed_events():
+    good = {
+        "schema": SCHEMA_VERSION, "event": "run_end", "t": 0.0,
+        "stop_reason": "niterations", "iterations": 1, "num_evals": 1.0,
+        "elapsed_s": 0.1, "recompiles_total": {},
+    }
+    assert validate_event(good) == []
+    assert validate_event({**good, "schema": "graftscope.v0"})
+    assert validate_event({**good, "event": "nope"})
+    missing = dict(good)
+    del missing["stop_reason"]
+    assert any("stop_reason" in e for e in validate_event(missing))
+    assert any(
+        "iterations" in e
+        for e in validate_event({**good, "iterations": "one"})
+    )
+    assert validate_lines(["not json\n"])
+    assert validate_lines([])  # empty file is a violation
+
+
+def test_report_summarize_synthetic():
+    counters = {
+        "proposed": {"add_node": 4, "crossover": 2},
+        "accepted": {"add_node": 1, "crossover": 2},
+        "reject_reasons": {"constraint": 3, "invalid": 0, "annealing": 0},
+        "candidates": 6, "invalid": 1, "eval_rows": 24, "eval_launches": 3,
+        "dedup": {"rows": 16, "unique": 12, "hits": 4},
+    }
+    events = [
+        {"schema": SCHEMA_VERSION, "event": "run_start", "t": 0.0,
+         "run_id": "r", "backend": "cpu", "n_devices": 1, "nout": 1,
+         "niterations": 1, "telemetry_interval": 1, "options": {},
+         "engines": []},
+        {"schema": SCHEMA_VERSION, "event": "iteration", "t": 1.0,
+         "iteration": 1, "num_evals": 10.0, "evals_per_sec": 10.0,
+         "elapsed_s": 1.0, "device_s": 0.9, "host_s": 0.1,
+         "host_fraction": 0.1,
+         "recompiles": {"traces": 5, "backend_compiles": 1},
+         "transfer_guard_hits": 0,
+         "outputs": [{"output": 1, "min_loss": 0.5, "pareto_volume": 1.0,
+                      "counters": counters, "loss_hist": [1],
+                      "complexity_hist": [1]}]},
+        {"schema": SCHEMA_VERSION, "event": "run_end", "t": 2.0,
+         "stop_reason": "niterations", "iterations": 1, "num_evals": 10.0,
+         "elapsed_s": 2.0, "recompiles_total": {}},
+    ]
+    assert all(validate_event(e) == [] for e in events)
+    s = summarize(events)
+    out = s["outputs"][0]
+    assert out["acceptance_rate"]["add_node"] == 0.25
+    assert out["acceptance_rate"]["crossover"] == 1.0
+    assert out["invalid_fraction"] == pytest.approx(1 / 6)
+    assert out["dedup_hit_rate"] == 0.25
+    assert s["iterations"]["recompiles"]["traces"] == 5
+    text = format_report(s)
+    assert "add_node" in text and "25.0%" in text

@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
-# Repo check entry point: graftlint static analysis + fast-tier tests.
-# CI runs exactly this; run it locally before pushing.
+# Repo check entry point: graftlint static analysis + fast-tier tests
+# + graftscope telemetry schema smoke. CI runs exactly this; run it
+# locally before pushing.
 #
-#   tools/check.sh            # lint + fast tests
+#   tools/check.sh            # lint + fast tests + telemetry smoke
 #   tools/check.sh --lint     # lint only (fast, no JAX compile)
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -17,3 +18,6 @@ fi
 echo "== fast-tier tests (pytest -m 'not slow') =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
+
+echo "== graftscope: telemetry JSONL schema check (docs/OBSERVABILITY.md) =="
+JAX_PLATFORMS=cpu python tools/telemetry_smoke.py

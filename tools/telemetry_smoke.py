@@ -1,0 +1,72 @@
+"""CI telemetry smoke: 2-iteration search with telemetry=True, schema
+validation of every emitted JSONL line, and a report-CLI pass.
+
+Run from the repo root (tools/check.sh step 3 and the CI
+``telemetry-smoke`` job)::
+
+    python tools/telemetry_smoke.py [out_dir]
+
+Writes ``<out_dir>/telemetry-smoke/telemetry.jsonl`` (default out_dir:
+``/tmp/sr_telemetry_smoke``) and exits nonzero on any schema violation
+or report failure — the file is uploaded as a CI build artifact either
+way, so a red run leaves the evidence behind.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SYMBOLIC_REGRESSION_IS_TESTING", "true")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def main() -> int:
+    import numpy as np
+
+    from symbolicregression_jl_tpu import Options, equation_search
+    from symbolicregression_jl_tpu.telemetry.report import main as report_main
+    from symbolicregression_jl_tpu.telemetry.schema import validate_lines
+
+    out_base = sys.argv[1] if len(sys.argv) > 1 else "/tmp/sr_telemetry_smoke"
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2.0, 2.0, (64, 2)).astype(np.float32)
+    y = (X[:, 0] * 2.0 + X[:, 1]).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=2,
+        tournament_selection_n=4,
+        optimizer_probability=0.0,
+        output_directory=out_base,
+        telemetry=True,
+    )
+    equation_search(
+        X, y, options=options, niterations=2, verbosity=0,
+        run_id="telemetry-smoke", seed=0,
+    )
+    path = os.path.join(out_base, "telemetry-smoke", "telemetry.jsonl")
+    if not os.path.exists(path):
+        print(f"FAIL: {path} was not written", file=sys.stderr)
+        return 1
+    with open(path) as f:
+        lines = f.readlines()
+    errors = validate_lines(lines)
+    if errors:
+        for e in errors:
+            print(f"schema violation: {e}", file=sys.stderr)
+        return 1
+    print(f"{path}: {len(lines)} events, schema valid")
+    rc = report_main(["report", path])
+    if rc != 0:
+        print("FAIL: telemetry report CLI failed", file=sys.stderr)
+        return rc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
