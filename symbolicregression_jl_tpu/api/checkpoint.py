@@ -8,20 +8,33 @@ key) serializes exactly — resume continues the *identical* search, not a
 re-parse approximation. The CSV dumps remain alongside for
 interoperability.
 
-Format: one pickle file holding numpy-ified device states plus a
-compatibility header (the same fields the in-memory warm start checks,
-src/OptionsStruct.jl:314-336) so an incompatible resume fails with a
-clear error before any state is touched.
+Format (v2): one pickle file holding an outer envelope
+``{"format": "srckpt.v2", "sha256": <hex>, "payload": <bytes>}`` whose
+payload bytes are the v1 payload dict (numpy-ified device states plus a
+compatibility header — the same fields the in-memory warm start checks,
+src/OptionsStruct.jl:314-336). The digest is verified on write (the tmp
+file is re-read before the atomic replace) and on load, so a truncated
+or bit-flipped checkpoint raises :class:`CheckpointCorruptError` instead
+of crashing mid-unpickle — the graftshield fallback machinery
+(shield/checkpoints.py) catches it and walks back to the newest *valid*
+rolling checkpoint. v1 files (bare payload pickle) still load.
+
+Multi-host runs write one file per host — ``path.rank{k}`` holding that
+host's addressable shards of every island-sharded array — and any host
+(or a later single-host process) reassembles the full state by reading
+all rank files from the shared run directory. No cross-host collectives
+are involved in either direction.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import glob
 import hashlib
 import os
 import pickle
 import warnings
-from typing import TYPE_CHECKING, List
+from typing import TYPE_CHECKING, Any, List, Optional
 
 import jax
 import numpy as np
@@ -30,9 +43,24 @@ if TYPE_CHECKING:  # pragma: no cover
     from ..core.options import Options
     from .search import SearchState
 
-__all__ = ["save_search_state", "load_search_state", "options_compat_header"]
+__all__ = [
+    "CheckpointCorruptError",
+    "save_search_state",
+    "load_search_state",
+    "options_compat_header",
+    "rank_shard_paths",
+]
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
+_ENVELOPE_MAGIC = "srckpt.v2"
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint file exists but cannot be consumed: truncated,
+    bit-flipped (digest mismatch), unpicklable, or an unknown format
+    version. Subclasses ValueError so pre-shield callers that caught
+    ValueError keep working; the shield's fallback loader catches this
+    specifically and falls back to the next rolling checkpoint."""
 
 
 def options_compat_header(options: "Options") -> dict:
@@ -124,55 +152,243 @@ def _key_impl_name(state: "SearchState") -> str:
     return str(jax.random.key_impl(state.device_states[0].key))
 
 
-def save_search_state(path: str, state: "SearchState") -> None:
-    """Serialize a SearchState (the ``return_state=True`` result) to disk.
+# ---------------------------------------------------------------------------
+# Envelope (digest-verified) writing and reading
+# ---------------------------------------------------------------------------
 
-    Double-write (tmp + atomic replace) matching the CSV checkpoint
-    discipline (src/SearchUtils.jl:605-649).
 
-    Multi-process runs skip the pickle: the state is island-sharded
-    across all hosts' devices, this function runs on rank 0 only, and
-    any cross-host gather here would be a one-sided collective (deadlock).
-    The per-iteration hall-of-fame CSVs remain the multi-host artifact.
-    """
-    if jax.process_count() > 1:
-        warnings.warn(
-            "save_search_state: skipping full-state pickle in a "
-            "multi-process run (island shards span non-addressable "
-            "devices); hall-of-fame CSVs are still written.",
-            stacklevel=2,
-        )
-        return
-    payload = {
-        "format_version": _FORMAT_VERSION,
-        "compat": options_compat_header(state.options),
-        "num_evals": float(state.num_evals),
-        "key_impl": _key_impl_name(state),
-        "nfeatures": state.nfeatures,
-        "device_states": [_to_numpy_state(ds) for ds in state.device_states],
-    }
+def _write_envelope(path: str, payload: dict) -> None:
+    """tmp + digest + verify-on-write + atomic replace.
+
+    The tmp file is re-read and its digest checked *before* the replace,
+    so a torn write (disk full, crash mid-flush) can never clobber the
+    previous good checkpoint with a bad one — the replace only happens
+    once the bytes on disk round-trip."""
+    blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    digest = hashlib.sha256(blob).hexdigest()
+    envelope = {"format": _ENVELOPE_MAGIC, "sha256": digest, "payload": blob}
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = path + ".bak"
     with open(tmp, "wb") as f:
-        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        pickle.dump(envelope, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
+    try:  # verify on write: the readback itself can hit the torn bytes
+        with open(tmp, "rb") as f:
+            back = pickle.load(f)
+        ok = hashlib.sha256(back["payload"]).hexdigest() == digest
+    except _UNPICKLE_ERRORS as e:
+        raise CheckpointCorruptError(
+            f"checkpoint readback of {tmp} failed after write "
+            f"({type(e).__name__}: {e}) — torn write or failing disk; "
+            "previous checkpoint left intact"
+        ) from e
+    if not ok:
+        raise CheckpointCorruptError(
+            f"checkpoint digest mismatch immediately after writing {tmp} "
+            "(torn write or failing disk); previous checkpoint left intact"
+        )
     os.replace(tmp, path)
 
 
-def load_search_state(path: str, options: "Options") -> "SearchState":
-    """Load a checkpoint for resumption under ``options``.
+# Unpickling a hostile/garbled stream can raise nearly anything; these
+# are the ones corrupt-but-honest files actually produce.
+_UNPICKLE_ERRORS = (
+    pickle.UnpicklingError, EOFError, AttributeError, ImportError,
+    IndexError, KeyError, TypeError, ValueError, MemoryError,
+    UnicodeDecodeError, OSError,
+)
 
-    Raises ValueError when the saved state is incompatible with the
-    given options (same contract as the in-memory warm start,
-    src/OptionsStruct.jl:314-336).
-    """
-    from .search import SearchState
 
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
-    if payload.get("format_version") != _FORMAT_VERSION:
-        raise ValueError(
-            f"Unsupported checkpoint format: {payload.get('format_version')}"
+def _read_payload(path: str) -> dict:
+    """Read + digest-verify one checkpoint file -> the payload dict.
+
+    Raises CheckpointCorruptError for anything short of a well-formed,
+    digest-matching file of a known format version; FileNotFoundError
+    passes through untouched (absent != corrupt)."""
+    try:
+        with open(path, "rb") as f:
+            outer = pickle.load(f)
+    except FileNotFoundError:
+        raise
+    except _UNPICKLE_ERRORS as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is unreadable (truncated or corrupt "
+            f"pickle): {type(e).__name__}: {e}"
+        ) from e
+    if isinstance(outer, dict) and outer.get("format") == _ENVELOPE_MAGIC:
+        blob = outer.get("payload")
+        if not isinstance(blob, (bytes, bytearray)) or (
+            hashlib.sha256(blob).hexdigest() != outer.get("sha256")
+        ):
+            raise CheckpointCorruptError(
+                f"checkpoint {path} failed sha256 digest verification "
+                "(bit rot or partial write)"
+            )
+        try:
+            payload = pickle.loads(blob)
+        except _UNPICKLE_ERRORS as e:  # digest ok but payload unloadable
+            raise CheckpointCorruptError(
+                f"checkpoint {path} payload failed to unpickle: "
+                f"{type(e).__name__}: {e}"
+            ) from e
+    else:
+        payload = outer  # format v1: bare payload pickle, no digest
+    if not isinstance(payload, dict) or (
+        payload.get("format_version") not in (1, _FORMAT_VERSION)
+    ):
+        got = payload.get("format_version") if isinstance(payload, dict) \
+            else type(payload).__name__
+        raise CheckpointCorruptError(
+            f"checkpoint {path} has unsupported format_version {got!r} "
+            f"(this build reads 1..{_FORMAT_VERSION})"
         )
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# Multi-host addressable-shard serialization
+# ---------------------------------------------------------------------------
+
+
+class _ShardRec:
+    """One island-sharded array leaf as seen by one host: the global
+    shape/dtype plus this host's (index, data) addressable shards.
+    Plain picklable object (slices pickle fine)."""
+
+    def __init__(self, shape, dtype, shards):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.shards = shards  # List[Tuple[index-tuple-of-slices, ndarray]]
+
+
+def rank_shard_paths(path: str, process_count: Optional[int] = None
+                     ) -> List[str]:
+    """The per-host shard file names for a base checkpoint path.
+
+    With ``process_count`` None, globs for whatever rank files exist
+    (load side); otherwise enumerates the expected set (save side)."""
+    if process_count is None:
+        found = []
+        # glob.escape: an output_directory/run_id containing [ ? * must
+        # not be read as a glob pattern (it would hide real rank files)
+        for p in glob.glob(glob.escape(path) + ".rank*"):
+            # strictly `.rank<int>` — tmp files from a torn write
+            # (`.rank2.bak`) or rolled names must NOT count as shards
+            try:
+                rank = int(p.rsplit(".rank", 1)[1])
+            except ValueError:
+                continue
+            found.append((rank, p))
+        # numeric sort, not lexicographic (rank10 after rank9)
+        return [p for _, p in sorted(found)]
+    return [f"{path}.rank{k}" for k in range(process_count)]
+
+
+def _to_shard_state(ds):
+    """Device state -> picklable pytree where non-fully-addressable
+    arrays become _ShardRec (this host's shards only) and everything
+    else becomes numpy."""
+    ds = dataclasses.replace(ds, key=jax.random.key_data(ds.key))
+
+    def rec(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return _ShardRec(
+                x.shape, np.asarray(x.addressable_shards[0].data).dtype,
+                [(s.index, np.asarray(s.data))
+                 for s in x.addressable_shards],
+            )
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(rec, ds)
+
+
+def _reassemble_states(rank_states: List[Any]) -> Any:
+    """Merge per-rank shard pytrees (same structure, _ShardRec leaves)
+    into one full-numpy pytree. Raises CheckpointCorruptError when the
+    rank set does not cover every element of a sharded array (a missing
+    or mismatched rank file)."""
+    leaves_per_rank = [jax.tree.flatten(
+        s, is_leaf=lambda x: isinstance(x, _ShardRec)) for s in rank_states]
+    leaves0, treedef = leaves_per_rank[0]
+    merged: List[Any] = []
+    for i, leaf in enumerate(leaves0):
+        if not isinstance(leaf, _ShardRec):
+            merged.append(leaf)
+            continue
+        out = np.empty(leaf.shape, dtype=leaf.dtype)
+        seen = np.zeros(leaf.shape, dtype=bool)
+        for leaves, _ in leaves_per_rank:
+            r = leaves[i]
+            if not isinstance(r, _ShardRec) or r.shape != leaf.shape:
+                raise CheckpointCorruptError(
+                    "multi-host checkpoint rank files disagree on array "
+                    f"structure at leaf {i}"
+                )
+            for index, data in r.shards:
+                out[index] = data
+                seen[index] = True
+        if not seen.all():
+            raise CheckpointCorruptError(
+                f"multi-host checkpoint is missing shards for leaf {i}: "
+                f"only {seen.mean():.0%} of elements covered — a rank "
+                "file is absent or was written by a different topology"
+            )
+        merged.append(out)
+    return jax.tree.unflatten(treedef, merged)
+
+
+# ---------------------------------------------------------------------------
+# Public save / load
+# ---------------------------------------------------------------------------
+
+
+def _base_payload(state: "SearchState") -> dict:
+    return {
+        "format_version": _FORMAT_VERSION,
+        "compat": options_compat_header(state.options),
+        "num_evals": float(state.num_evals),
+        "iterations_done": int(getattr(state, "iterations_done", 0)),
+        "key_impl": _key_impl_name(state),
+        "nfeatures": state.nfeatures,
+    }
+
+
+def save_search_state(path: str, state: "SearchState") -> None:
+    """Serialize a SearchState (the ``return_state=True`` result) to disk.
+
+    Double-write (tmp + digest verify + atomic replace) extending the CSV
+    checkpoint discipline (src/SearchUtils.jl:605-649).
+
+    Multi-process runs: EVERY rank must call this with the same ``path``
+    on a shared filesystem; rank ``k`` writes ``path.rank{k}`` holding
+    its addressable shards of the island-sharded arrays (no cross-host
+    collectives, no window where a half-gathered state could deadlock).
+    ``load_search_state`` reassembles the full state from the rank set.
+    """
+    if jax.process_count() > 1:
+        shard_payload = dict(_base_payload(state))
+        shard_payload.update({
+            "multihost": {
+                "process_index": int(jax.process_index()),
+                "process_count": int(jax.process_count()),
+            },
+            "device_states": [
+                _to_shard_state(ds) for ds in state.device_states
+            ],
+        })
+        _write_envelope(
+            f"{path}.rank{jax.process_index()}", shard_payload
+        )
+        return
+    payload = dict(_base_payload(state))
+    payload["device_states"] = [
+        _to_numpy_state(ds) for ds in state.device_states
+    ]
+    _write_envelope(path, payload)
+
+
+def _check_compat(payload: dict, options: "Options", path: str) -> None:
     saved = payload["compat"]
     now = options_compat_header(options)
     issues = [k for k in now
@@ -188,8 +404,33 @@ def load_search_state(path: str, options: "Options") -> "SearchState":
             "Checkpoint was saved under a template combine function whose "
             "fingerprint differs from the current one; resuming will score "
             "carried-over losses under the new objective.",
-            stacklevel=2,
+            stacklevel=3,
         )
+
+
+def load_search_state(path: str, options: "Options") -> "SearchState":
+    """Load a checkpoint for resumption under ``options``.
+
+    Raises :class:`CheckpointCorruptError` when the file (or any of its
+    multi-host rank files) is truncated/corrupt/unknown-format, and
+    ValueError when the saved state is incompatible with the given
+    options (same contract as the in-memory warm start,
+    src/OptionsStruct.jl:314-336). A base path whose ``path.rank{k}``
+    files exist loads the multi-host set and reassembles the full state.
+    """
+    from .search import SearchState
+
+    if not os.path.exists(path):
+        rank_files = rank_shard_paths(path)
+        if rank_files:
+            return _load_multihost(path, rank_files, options)
+        raise FileNotFoundError(path)
+    payload = _read_payload(path)
+    if "multihost" in payload:
+        # A rank file passed directly: load the whole set it belongs to.
+        base = path.rsplit(".rank", 1)[0]
+        return _load_multihost(base, rank_shard_paths(base), options)
+    _check_compat(payload, options, path)
     device_states = [
         _to_device_state(ds, payload.get("key_impl", "threefry2x32"))
         for ds in payload["device_states"]
@@ -200,4 +441,54 @@ def load_search_state(path: str, options: "Options") -> "SearchState":
         options=options,
         num_evals=float(payload["num_evals"]),
         nfeatures=payload.get("nfeatures"),
+        iterations_done=int(payload.get("iterations_done", 0)),
+    )
+
+
+def _load_multihost(base: str, rank_files: List[str], options: "Options"
+                    ) -> "SearchState":
+    from .search import SearchState
+
+    if not rank_files:
+        raise FileNotFoundError(base)
+    payloads = [_read_payload(p) for p in rank_files]
+    counts = {p["multihost"]["process_count"] for p in payloads}
+    if len(counts) != 1 or counts.pop() != len(payloads):
+        raise CheckpointCorruptError(
+            f"multi-host checkpoint {base} has {len(payloads)} rank "
+            f"file(s) but they declare process_count "
+            f"{sorted(p['multihost']['process_count'] for p in payloads)}"
+        )
+    # Same GENERATION on every rank: a host that died (or was signaled)
+    # at a different iteration than the others leaves shard files from
+    # different states — reassembling them would hand resume a chimera
+    # population with no error. iterations_done + num_evals pin it.
+    gens = {
+        (int(p.get("iterations_done", 0)), float(p["num_evals"]))
+        for p in payloads
+    }
+    if len(gens) != 1:
+        raise CheckpointCorruptError(
+            f"multi-host checkpoint {base} mixes generations: rank files "
+            f"disagree on (iterations_done, num_evals): {sorted(gens)} — "
+            "fall back to an older rolling generation"
+        )
+    head = payloads[0]
+    _check_compat(head, options, base)
+    n_out = len(head["device_states"])
+    device_states = []
+    for j in range(n_out):
+        merged = _reassemble_states(
+            [p["device_states"][j] for p in payloads]
+        )
+        device_states.append(
+            _to_device_state(merged, head.get("key_impl", "threefry2x32"))
+        )
+    return SearchState(
+        device_states=device_states,
+        hofs=[],
+        options=options,
+        num_evals=float(head["num_evals"]),
+        nfeatures=head.get("nfeatures"),
+        iterations_done=int(head.get("iterations_done", 0)),
     )

@@ -161,7 +161,12 @@ class SRRegressor:
         X_units=None,
         y_units=None,
         category=None,
+        resume: Optional[str] = None,
     ) -> "SRRegressor":
+        """Run the search. ``resume="auto"`` (or a checkpoint/run-dir
+        path) continues a preempted search from the newest valid
+        graftshield checkpoint, treating ``niterations`` as the total
+        target — see ``equation_search`` / docs/ROBUSTNESS.md."""
         X, table_names = _coerce_table(X)
         if variable_names is None and table_names is not None:
             variable_names = table_names
@@ -181,7 +186,7 @@ class SRRegressor:
 
         new_options = self._make_options()
         saved_state = None
-        if self.warm_start and self.state_ is not None:
+        if resume is None and self.warm_start and self.state_ is not None:
             issues = new_options.check_warm_start_compatibility(self.options_)
             if issues:
                 raise ValueError(
@@ -239,6 +244,7 @@ class SRRegressor:
             y_units=y_units,
             extra=extra,
             saved_state=saved_state,
+            resume=resume,
             runtime_options=ropt,
         )
         self.state_ = state

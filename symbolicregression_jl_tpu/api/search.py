@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
+import re
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -51,6 +52,11 @@ def _default_run_id() -> str:
     # timestamp + random suffix (src/SearchUtils.jl:236-240)
     stamp = time.strftime("%Y%m%d_%H%M%S")
     return f"{stamp}_{uuid.uuid4().hex[:6]}"
+
+
+# The shape _default_run_id produces — used to recognize a run_id nobody
+# chose explicitly (multi-host runs must not keep per-host random ids).
+_DEFAULT_RUN_ID_RE = re.compile(r"^\d{8}_\d{6}_[0-9a-f]{6}$")
 
 
 @dataclasses.dataclass
@@ -100,6 +106,13 @@ class SearchState:
     # positionally, so resuming against a dataset with a different
     # feature count would silently mis-evaluate.
     nfeatures: Optional[List[int]] = None
+    # Iterations already completed when this state was captured.
+    # ``equation_search(resume=...)`` treats ``niterations`` as the
+    # TOTAL target and runs only the remainder — which is what makes a
+    # preempted-and-resumed search bit-identical to an uninterrupted
+    # one. (Plain ``saved_state=`` warm starts keep the historical
+    # semantics: run ``niterations`` MORE iterations.)
+    iterations_done: int = 0
 
 
 def _resolve_datasets(
@@ -467,6 +480,7 @@ def equation_search(
     guesses: Optional[Sequence] = None,
     initial_population: Optional[Sequence] = None,
     saved_state: Optional[Union[SearchState, str]] = None,
+    resume: Optional[str] = None,
     runtime_options: Optional[RuntimeOptions] = None,
     verbosity: Optional[int] = None,
     progress: Optional[bool] = None,
@@ -481,6 +495,15 @@ def equation_search(
     (src/SymbolicRegression.jl:359-474) with TPU-native execution. Returns
     the hall of fame (list for multi-output), or ``(state, hof)`` when
     ``return_state=True``.
+
+    ``resume="auto"`` discovers the newest valid checkpoint under the
+    output base (falling back past corrupt files to older rolling
+    generations) and continues it, treating ``niterations`` as the
+    TOTAL target — a preempted-and-resumed search is bit-identical to
+    an uninterrupted one. ``resume=<path>`` names a checkpoint file or
+    run directory explicitly. ``saved_state=`` keeps the historical
+    warm-start semantics (run ``niterations`` MORE iterations). See
+    docs/ROBUSTNESS.md for the full graftshield failure model.
 
     Process-global side effect: unless opted out (SR_NO_COMPILE_CACHE=1)
     or already configured, the first call on a non-CPU backend enables
@@ -524,12 +547,86 @@ def equation_search(
             "deterministic=True requires a seed (pass seed= or Options(seed=...))"
         )
 
+    if resume is not None and saved_state is not None:
+        raise ValueError("pass either resume= or saved_state=, not both")
+
+    # The one place the default checkpoint/CSV output base is computed:
+    # resume="auto" discovery and out_dir below MUST agree on it.
+    out_base = options.output_directory or (
+        "outputs" if not os.environ.get("SYMBOLIC_REGRESSION_IS_TESTING")
+        else os.path.join(os.environ.get("TMPDIR", "/tmp"), "sr_outputs")
+    )
+
+    # Multi-host: every rank must write its checkpoint shard into the
+    # SAME run directory, but the default run_id carries a per-process
+    # random suffix — each host would invent its own directory and the
+    # rank-shard set could never reassemble. Replace a defaulted id with
+    # a seed-derived deterministic one (an SPMD-correct multi-host run
+    # already requires the same seed on every host; the device RNG key
+    # comes from it).
+    if (jax.process_count() > 1
+            and _DEFAULT_RUN_ID_RE.match(ropt.run_id)):
+        if ropt.seed is None:
+            raise ValueError(
+                "multi-host runs need a deterministic identity shared by "
+                "every rank: pass run_id= (same on every host) or a seed"
+            )
+        ropt.run_id = f"multihost_seed{ropt.seed}"
+
     if isinstance(saved_state, (str, os.PathLike)):
         # On-disk checkpoint resume (the cross-process analogue of the
         # reference's saved-output reload, src/SymbolicRegression.jl:760-821).
         from .checkpoint import load_search_state
 
         saved_state = load_search_state(os.fspath(saved_state), options)
+
+    # ---- graftshield resume (docs/ROBUSTNESS.md) ----
+    # resume="auto" discovers the newest run directory with a checkpoint
+    # under the output base; resume=<path> names a checkpoint file or
+    # run directory. Either way the load walks the rolling set and falls
+    # back past corrupt files to the newest VALID one, and niterations
+    # becomes the TOTAL target: only the remaining iterations run, so a
+    # preempted-then-resumed search is bit-identical to an uninterrupted
+    # one (tests/test_shield.py pins this).
+    start_iter = 0
+    resume_events: List[Dict[str, Any]] = []
+    if resume is not None:
+        from ..shield.checkpoints import (
+            discover_resume_path,
+            load_newest_valid,
+        )
+
+        search_base = out_base if resume == "auto" else os.fspath(resume)
+        # max(): a checkpoint_keep raised mid-project must still reach
+        # older generations written under the larger setting, and a
+        # lowered one must not blind resume to files already on disk.
+        candidates = discover_resume_path(
+            search_base, keep=max(8, options.checkpoint_keep))
+        if candidates is None:
+            if resume != "auto":
+                raise FileNotFoundError(
+                    f"resume={resume!r}: no checkpoint found there"
+                )
+            if ropt.verbosity >= 1:
+                print(
+                    f"resume='auto': no checkpoint under {search_base}; "
+                    "starting fresh"
+                )
+        else:
+            corrupt_log: List[Tuple[str, str]] = []
+            saved_state, used_path = load_newest_valid(
+                candidates, options, corrupt_log=corrupt_log)
+            for bad_path, err in corrupt_log:
+                resume_events.append({
+                    "kind": "checkpoint_corrupt",
+                    "detail": {"path": bad_path, "error": err[:500]},
+                })
+            start_iter = int(saved_state.iterations_done)
+            resume_events.append({
+                "kind": "resume",
+                "detail": {"path": used_path,
+                           "iterations_done": start_iter},
+            })
 
     datasets = _resolve_datasets(
         X, y, weights, variable_names, display_variable_names,
@@ -561,14 +658,13 @@ def equation_search(
     )
 
     out_dir = None
-    # Multi-host: only rank 0 writes CSVs/checkpoints (every host runs
-    # the same program and would race on the same files).
-    if options.save_to_file and jax.process_index() == 0:
-        base = options.output_directory or (
-            "outputs" if not os.environ.get("SYMBOLIC_REGRESSION_IS_TESTING")
-            else os.path.join(os.environ.get("TMPDIR", "/tmp"), "sr_outputs")
-        )
-        out_dir = os.path.join(base, ropt.run_id)
+    # Multi-host: every rank computes the SAME run directory (full-state
+    # checkpoints need every rank to write its own `.rank{k}` shard
+    # file, api/checkpoint.py), but only rank 0 writes the CSVs and the
+    # telemetry stream — those would race on identical content.
+    is_rank0 = jax.process_index() == 0
+    if options.save_to_file:
+        out_dir = os.path.join(out_base, ropt.run_id)
 
     total_cycles = ropt.niterations * options.ncycles_per_iteration
     engines: List[Engine] = []
@@ -691,10 +787,21 @@ def equation_search(
         datas.append(data)
 
     hofs: List[HallOfFame] = [HallOfFame(entries=[]) for _ in datasets]
+    if saved_state is not None:
+        # A resumed search that runs zero further iterations (target
+        # already reached) must still return the saved hall of fame, and
+        # the quarantine/telemetry paths want a decoded HoF from the
+        # first boundary on.
+        for j, engine in enumerate(engines):
+            if j < len(states):
+                hofs[j] = HallOfFame.from_device(
+                    states[j].hof, options.operators,
+                    template=engine.template,
+                )
     start_time = time.time()
     num_evals0 = saved_state.num_evals if saved_state is not None else 0.0
     stop_reason = None
-    cycles_remaining = total_cycles
+    cycles_remaining = total_cycles - start_iter * options.ncycles_per_iteration
 
     # ---- graftscope telemetry hub (telemetry/hub.py) ----
     # One object owns every per-iteration consumer — the SRLogger, the
@@ -720,7 +827,7 @@ def equation_search(
         ],
     )
     recorder = None
-    if options.use_recorder:
+    if options.use_recorder and is_rank0:
         rec_path = (
             os.path.join(out_dir, options.recorder_file)
             if out_dir is not None
@@ -741,6 +848,71 @@ def equation_search(
     if bar is not None:
         hub.add_sink(ProgressSink(bar))
 
+    # ---- graftshield supervision (shield/ package, docs/ROBUSTNESS.md) --
+    # Preemption guard: SIGTERM/SIGINT set a flag the budget poll reads;
+    # the loop then stops at the iteration boundary with
+    # stop_reason="preempted" and the end-of-loop write becomes the
+    # emergency checkpoint. Watchdog: per-phase deadlines on the device
+    # dispatch (compile_budget on compile-bearing iterations,
+    # iteration_deadline warm). Runner: transient-failure retry/backoff
+    # + eval-shape degradation. Quarantine: NaN-storm island reseed.
+    from ..shield.degrade import ShieldRunner
+    from ..shield.faults import active_injector
+    from ..shield.quarantine import IslandQuarantine
+    from ..shield.signals import PreemptionGuard
+    from ..shield.watchdog import Watchdog
+
+    shield_on = bool(options.shield)
+    guard = PreemptionGuard()
+    if shield_on:
+        guard.install()
+    watchdog = Watchdog(
+        dump_path=(os.path.join(out_dir, "watchdog_dump.txt")
+                   if out_dir is not None and is_rank0 else None),
+        telemetry=hub,
+    ) if shield_on else None
+    runner = ShieldRunner(
+        max_retries=options.max_retries, backoff=options.retry_backoff,
+        telemetry=hub,
+    ) if shield_on else None
+    # Quarantine is single-process only for now: the [I] invalid-
+    # fraction vector is island-sharded, and fetching it from a process
+    # that does not address every shard raises. (A multi-host variant
+    # needs an in-graph allgather of the mask — documented limitation,
+    # docs/ROBUSTNESS.md.)
+    quarantine = IslandQuarantine(
+        threshold=options.quarantine_invalid_fraction, telemetry=hub,
+    ) if (shield_on and options.island_quarantine
+          and jax.process_count() == 1) else None
+    injector = active_injector(telemetry=hub) if shield_on else None
+    for ev in resume_events:
+        hub.fault(ev["kind"], iteration=start_iter, **ev["detail"])
+    # Rolling full-state checkpoints (digest-verified, last
+    # options.checkpoint_keep generations; shield/checkpoints.py). All
+    # ranks construct it: multi-host saves write one rank-shard file per
+    # host (api/checkpoint.py).
+    from ..shield.checkpoints import RollingCheckpointer
+
+    ckpt = (
+        RollingCheckpointer(
+            os.path.join(out_dir, "search_state.pkl"),
+            keep=options.checkpoint_keep,
+        )
+        if out_dir is not None else None
+    )
+
+    last_ckpt_it = -1
+
+    def _checkpoint_state() -> "SearchState":
+        return SearchState(
+            device_states=list(states),
+            hofs=hofs,
+            options=options,
+            num_evals=num_evals0 + sum(float(s.num_evals) for s in states),
+            nfeatures=[ds.nfeatures for ds in datasets],
+            iterations_done=it,
+        )
+
     # Interactive quit ('q' / ctrl-d on stdin; StdinReader analogue).
     from ..utils.stdin_quit import StdinQuitWatcher
 
@@ -751,7 +923,15 @@ def equation_search(
 
         def _budget_stop(pending_evals=None) -> Optional[str]:
             """``pending_evals``: optional thunk for not-yet-landed evals of a
-            partially-run iteration (only forced when max_evals is set)."""
+            partially-run iteration (only forced when max_evals is set).
+
+            Deliberately does NOT poll the preemption guard: this
+            predicate also runs between evolve chunks, and a preempt
+            that truncated an iteration mid-flight would checkpoint a
+            state no uninterrupted run ever reaches — breaking the
+            resume="auto" bit-identity contract. The guard is checked
+            once per iteration, at the boundary (below), which is also
+            where the emergency checkpoint is defined to happen."""
             if watcher.check():
                 return "user_quit"
             if (
@@ -821,7 +1001,7 @@ def equation_search(
         monitor = ResourceMonitor()
         host_t0 = time.time()
 
-        it = 0
+        it = start_iter
         used_chunk_sets = set()
         # Device-side cur_maxsize cache: the value only changes while the
         # maxsize warmup ramps, so upload it on change instead of paying a
@@ -843,20 +1023,57 @@ def equation_search(
             fresh_compile = tuple(chunk_sizes) not in used_chunk_sets
             used_chunk_sets.add(tuple(chunk_sizes))
             iter_events = [None] * len(engines)
-            # sr:iteration span: one profiler step per search iteration, so a
-            # perfetto/xplane capture lines up device work with iterations.
+            # Watchdog budgets: compile-bearing dispatches (first of
+            # this process, a fresh chunk-size set, or any re-attempt
+            # after retry/degrade — a degrade drops the compiled
+            # programs) are bounded by compile_budget; warm dispatches
+            # by iteration_deadline. Each ATTEMPT gets its own phase so
+            # the shield's recovery work between attempts (backoff
+            # sleeps, the degrade recompile decision) is never inside a
+            # supervised window — the watchdog must not kill the exact
+            # recovery it coexists with. None budgets = unsupervised.
+            compiling = fresh_compile or it == start_iter
+            dispatch_count = {"n": 0}
+
+            def _phase_for_attempt():
+                import contextlib
+
+                if watchdog is None:
+                    return contextlib.nullcontext()
+                comp = compiling or dispatch_count["n"] > len(engines)
+                budget = (options.compile_budget if comp
+                          else options.iteration_deadline)
+                return watchdog.phase("compile" if comp else "iteration",
+                                      budget, iteration=it + 1)
+
+            # sr:iteration span: one profiler step per search iteration,
+            # so a perfetto/xplane capture lines up device work with
+            # iterations.
             with step_span(it + 1):
                 for j, (engine, data) in enumerate(zip(engines, datas)):
-                    out = engine.run_iteration(
-                        states[j], data, cur_maxsize_dev,
-                        chunk_sizes=chunk_sizes if len(chunk_sizes) > 1 else None,
-                        should_stop=_budget_hit,
-                    )
+                    def one(j=j, engine=engine, data=data):
+                        dispatch_count["n"] += 1
+                        if injector is not None:
+                            injector.on_dispatch(it + 1)
+                        with _phase_for_attempt():
+                            return engine.run_iteration(
+                                states[j], data, cur_maxsize_dev,
+                                chunk_sizes=(chunk_sizes
+                                             if len(chunk_sizes) > 1
+                                             else None),
+                                should_stop=_budget_hit,
+                            )
+                    if runner is not None:
+                        out = runner.run(one, iteration=it + 1,
+                                         engine=engine, output=j + 1)
+                    else:
+                        out = one()
                     if engine.cfg.record_events:
                         states[j], iter_events[j] = out
                     else:
                         states[j] = out
-                jax.block_until_ready(states[-1].pops.cost)
+                with _phase_for_attempt():
+                    jax.block_until_ready(states[-1].pops.cost)
             host_t0 = time.time()
             # Adapt chunk count toward the stop-latency target using this
             # iteration's measured device time, quantized to powers of two —
@@ -878,6 +1095,24 @@ def equation_search(
             cycles_remaining -= options.ncycles_per_iteration
             it += 1
 
+            # graftshield boundary work: fault injection hooks fire
+            # first (a poisoned island must be visible to the quarantine
+            # scan below, the same ordering a real storm has), then the
+            # quarantine reseeds any collapsed islands from the HoF.
+            if injector is not None:
+                states = injector.on_iteration_end(it, states)
+            if quarantine is not None:
+                for j, engine in enumerate(engines):
+                    states[j] = quarantine.check_and_reseed(
+                        engine, states[j], iteration=it, output=j + 1
+                    )
+            if guard.requested and stop_reason is None:
+                stop_reason = "preempted"
+                hub.fault(
+                    "preempt_signal", iteration=it,
+                    signal=guard.signal_name,
+                )
+
             # Host-side bookkeeping once per iteration (not per cycle).
             total_evals = num_evals0 + sum(
                 float(s.num_evals) for s in states
@@ -890,7 +1125,7 @@ def equation_search(
                     )
             with host_span("checkpoint"):
                 for j, ds in enumerate(datasets):
-                    if out_dir is not None:
+                    if out_dir is not None and is_rank0:
                         fname = (
                             "hall_of_fame.csv"
                             if len(datasets) == 1
@@ -901,25 +1136,17 @@ def equation_search(
                             options.operators,
                             variable_names=ds.variable_names,
                         )
-                if out_dir is not None and it % ropt.checkpoint_every_n == 0:
-                    # Periodic full-state checkpoint next to the CSVs: kill
-                    # the process at a checkpoint boundary and resume with
-                    # equation_search(..., saved_state=<path>). Not every
-                    # iteration — the population pytree is much larger than
-                    # the HoF CSVs; the final/stopping state is written once
-                    # after the loop.
-                    from .checkpoint import save_search_state
-
-                    save_search_state(
-                        os.path.join(out_dir, "search_state.pkl"),
-                        SearchState(
-                            device_states=list(states),
-                            hofs=hofs,
-                            options=options,
-                            num_evals=total_evals,
-                            nfeatures=[ds.nfeatures for ds in datasets],
-                        ),
-                    )
+                if ckpt is not None and it % ropt.checkpoint_every_n == 0:
+                    # Periodic full-state checkpoint next to the CSVs:
+                    # kill the process at a checkpoint boundary and
+                    # resume with equation_search(resume="auto") (or
+                    # saved_state=<path>). Rolling last-K, digest-
+                    # verified (shield/checkpoints.py). Not every
+                    # iteration — the population pytree is much larger
+                    # than the HoF CSVs; the final/stopping state is
+                    # written once after the loop.
+                    ckpt.save(_checkpoint_state())
+                    last_ckpt_it = it
 
             # One hub dispatch replaces the old ad-hoc recorder/logger/bar
             # wiring: fetch device counters, merge timings + compile events,
@@ -964,21 +1191,23 @@ def equation_search(
                 stop_reason = _budget_stop()
 
         watcher.stop()
-        if out_dir is not None and it > 0:
+        if ckpt is not None and it > start_iter and it != last_ckpt_it:
+            # `it > start_iter`, not `it > 0`: a resume that ran zero
+            # further iterations (target already reached) must not
+            # re-save an identical state — each such save would rotate
+            # away one distinct older generation of the rolling set.
             # Guarantee the final/stopping state is checkpointed even when
             # the stop was detected after the periodic write (early-stop
-            # condition or end-of-loop budget check).
-            from .checkpoint import save_search_state
-
-            save_search_state(
-                os.path.join(out_dir, "search_state.pkl"),
-                SearchState(
-                    device_states=list(states),
-                    hofs=hofs,
-                    options=options,
-                    num_evals=num_evals0 + sum(float(s.num_evals) for s in states),
-                    nfeatures=[ds.nfeatures for ds in datasets],
-                ),
+            # condition, end-of-loop budget check, or a preemption
+            # signal — for "preempted" this IS the emergency checkpoint
+            # the SIGTERM handler deferred to the iteration boundary).
+            # Skipped only when this exact iteration already saved (it
+            # would duplicate the state and burn a rolling generation).
+            ckpt.save(_checkpoint_state())
+        if ckpt is not None and it > 0 and stop_reason == "preempted":
+            hub.fault(
+                "emergency_checkpoint", iteration=it,
+                path=ckpt.base, iterations_done=it,
             )
         # Flush any partial telemetry interval, emit run_end, close sinks
         # (ProgressBar close, Recorder final-state + write).
@@ -990,8 +1219,12 @@ def equation_search(
     finally:
         # A failing or interrupted search must still release the
         # hub's process-global jax.monitoring compile listener
-        # (idempotent after a clean finish).
+        # (idempotent after a clean finish) and the graftshield
+        # process-globals (signal handlers, watchdog thread).
         hub.close()
+        guard.uninstall()
+        if watchdog is not None:
+            watchdog.stop()
 
     if ropt.verbosity >= 1:
         for j, (hof, ds) in enumerate(zip(hofs, datasets)):
@@ -1015,6 +1248,7 @@ def equation_search(
             options=options,
             num_evals=num_evals0 + sum(float(s.num_evals) for s in states),
             nfeatures=[ds.nfeatures for ds in datasets],
+            iterations_done=it,
         )
         return host_state, result
     return result

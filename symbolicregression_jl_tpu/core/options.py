@@ -351,6 +351,36 @@ class Options:
         telemetry: bool = False,
         telemetry_file: str = "telemetry.jsonl",
         telemetry_interval: int = 1,
+        # graftshield fault tolerance (shield/ package, docs/ROBUSTNESS.md):
+        # `shield` arms the whole supervision layer in equation_search —
+        # SIGTERM/SIGINT → graceful stop + emergency checkpoint at the
+        # next iteration boundary, transient-failure retries, and (when
+        # island_quarantine is on) NaN-storm island reseeding. The
+        # watchdog deadlines are opt-in per budget: `iteration_deadline`
+        # bounds a warm device iteration, `compile_budget` bounds
+        # compile-bearing dispatches (first use of a program); on expiry
+        # the watchdog aborts with a thread-stack diagnostic dump
+        # instead of hanging until an external timeout (rc=124).
+        shield: bool = True,
+        iteration_deadline: Optional[float] = None,
+        compile_budget: Optional[float] = None,
+        # Rolling checkpoint depth: search_state.pkl plus the previous
+        # (checkpoint_keep - 1) generations, digest-verified; resume
+        # falls back to the newest valid one on corruption.
+        checkpoint_keep: int = 3,
+        # Transient-failure policy: bounded exponential backoff
+        # (retry_backoff * 2^k seconds, capped at 30) for max_retries
+        # attempts, then eval-tile-rows degradation on OOM-shaped
+        # failures, then raise.
+        max_retries: int = 3,
+        retry_backoff: float = 0.5,
+        # Island quarantine: islands whose non-finite member fraction
+        # reaches quarantine_invalid_fraction are reseeded from the hall
+        # of fame in-graph. The 1.0 default only fires on a FULLY
+        # collapsed island, so healthy searches are bit-identical with
+        # the feature on or off until a genuine NaN storm hits.
+        island_quarantine: bool = True,
+        quarantine_invalid_fraction: float = 1.0,
         # Run the graftlint runtime auditor (lint/runtime.py
         # validate_programs) over every engine state: postfix-encoding
         # invariants are re-checked after init and after each iteration's
@@ -535,6 +565,18 @@ class Options:
         self.telemetry = bool(telemetry)
         self.telemetry_file = str(telemetry_file)
         self.telemetry_interval = int(telemetry_interval)
+        self.shield = bool(shield)
+        self.iteration_deadline = (
+            None if iteration_deadline is None else float(iteration_deadline)
+        )
+        self.compile_budget = (
+            None if compile_budget is None else float(compile_budget)
+        )
+        self.checkpoint_keep = int(checkpoint_keep)
+        self.max_retries = int(max_retries)
+        self.retry_backoff = float(retry_backoff)
+        self.island_quarantine = bool(island_quarantine)
+        self.quarantine_invalid_fraction = float(quarantine_invalid_fraction)
         self.debug_checks = bool(debug_checks)
         self.print_precision = int(print_precision)
         self.progress = progress
@@ -562,6 +604,20 @@ class Options:
             raise ValueError("eval_tile_rows must be positive")
         if self.telemetry_interval < 1:
             raise ValueError("telemetry_interval must be >= 1")
+        if self.checkpoint_keep < 1:
+            raise ValueError("checkpoint_keep must be >= 1")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.retry_backoff < 0:
+            raise ValueError("retry_backoff must be >= 0")
+        if not (0.0 < self.quarantine_invalid_fraction <= 1.0):
+            raise ValueError(
+                "quarantine_invalid_fraction must be in (0, 1]"
+            )
+        for name in ("iteration_deadline", "compile_budget"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be positive (or None)")
 
     @property
     def nops(self):

@@ -150,8 +150,21 @@ class Engine:
                      and self.cfg.turbo and not self.cfg.interpret),
         )
         self.window_size = float(window_size)
+        self._build_jits()
+
+    def _build_jits(self) -> None:
+        """(Re)create the jitted entry points against the CURRENT
+        ``self.cfg`` and drop every cached compiled program. Called once
+        from ``__init__`` and again by ``degrade_eval_tile_rows`` — the
+        graftshield degradation ladder rewrites the launch geometry and
+        the old traces must not serve it."""
         self._iteration = jax.jit(self._iteration_impl, donate_argnums=(0,))
         self._init_state = jax.jit(self._init_state_impl, static_argnums=(2,))
+        for attr in ("_chunk_cache", "_epilogue_jit", "_prelude_jit",
+                     "_reseed_jit", "_invalid_frac_jit"):
+            if hasattr(self, attr):
+                delattr(self, attr)
+
         # (cost, loss, complexity) for a flat batch of host-encoded trees —
         # the guess-seeding / warm-start re-eval path.
         def eval_cost_flat(trees, data, member_params=None):
@@ -170,6 +183,21 @@ class Engine:
             )
 
         self._eval_cost = jax.jit(eval_cost_flat)
+
+    def degrade_eval_tile_rows(self, floor: int = 512) -> Optional[int]:
+        """graftshield degradation step (shield/degrade.py): halve the
+        candidate-eval kernel's row-tile cap and drop the compiled
+        programs so the next dispatch re-lowers at the smaller launch
+        geometry (smaller live buffers per launch under RESOURCE_
+        EXHAUSTED pressure). Returns the new tile rows, or None when
+        already at the floor (the ladder is exhausted)."""
+        cur = int(self.cfg.eval_tile_rows)
+        new = max(cur // 2, int(floor))
+        if new >= cur:
+            return None
+        self.cfg = self.cfg._replace(eval_tile_rows=new)
+        self._build_jits()
+        return new
 
     @property
     def n_params(self) -> int:
@@ -962,6 +990,93 @@ class Engine:
             pops=pops, hof=hof, stats=stats, birth=birth, ref=ref,
             num_evals=num_evals, key=key, telem=telem,
         )
+
+    # ------------------------------------------------------------------
+    # graftshield quarantine primitives (shield/quarantine.py drives
+    # these from the host loop; docs/ROBUSTNESS.md)
+    # ------------------------------------------------------------------
+    def island_invalid_fractions(self, state: SearchDeviceState):
+        """[I] fraction of non-finite member losses per island — the
+        NaN-storm detector input. One tiny jitted reduction, never part
+        of the iteration program."""
+        if not hasattr(self, "_invalid_frac_jit"):
+            self._invalid_frac_jit = jax.jit(
+                lambda loss: jnp.mean(
+                    (~jnp.isfinite(loss)).astype(jnp.float32), axis=1
+                )
+            )
+        return self._invalid_frac_jit(state.pops.loss)
+
+    def reseed_islands(self, state: SearchDeviceState,
+                       mask) -> SearchDeviceState:
+        """Reseed the islands selected by ``mask`` ([I] bool) from the
+        hall of fame, entirely in-graph: each masked island's members
+        are replaced by the existing HoF entries tiled across the
+        population slots (costs/losses/params carried over — HoF costs
+        are full-dataset finalized, so no re-eval is needed). Unmasked
+        islands are untouched; with an empty HoF the call is an
+        identity. Deterministic — no RNG draws — so interrupted/resumed
+        searches quarantine identically."""
+        if not hasattr(self, "_reseed_jit"):
+            cfg = self.cfg
+
+            def reseed(state, mask):
+                P = cfg.population_size
+                hof = state.hof
+                I = state.pops.cost.shape[0]
+                exists = hof.exists
+                n_exist = jnp.sum(exists.astype(jnp.int32))
+                mask = mask & (n_exist > 0)
+                exist_idx = jnp.nonzero(
+                    exists, size=cfg.maxsize, fill_value=0)[0]
+                slot = jnp.take(
+                    exist_idx,
+                    jnp.arange(P) % jnp.maximum(n_exist, 1),
+                )
+
+                def tile(x):  # hof field [maxsize, ...] -> [P, ...]
+                    return jnp.take(x, slot, axis=0)
+
+                def sel(orig, repl):  # orig [I, P, ...], repl [P, ...]
+                    m = mask.reshape((I,) + (1,) * (orig.ndim - 1))
+                    return jnp.where(
+                        m, jnp.broadcast_to(repl[None], orig.shape), orig
+                    )
+
+                pops = state.pops
+                fresh_ticks = (
+                    state.birth[:, None]
+                    + jnp.arange(P, dtype=jnp.int32)[None, :]
+                )
+                new_pops = dataclasses.replace(
+                    pops,
+                    trees=TreeBatch(
+                        arity=sel(pops.trees.arity, tile(hof.trees.arity)),
+                        op=sel(pops.trees.op, tile(hof.trees.op)),
+                        feat=sel(pops.trees.feat, tile(hof.trees.feat)),
+                        const=sel(pops.trees.const, tile(hof.trees.const)),
+                        length=sel(pops.trees.length,
+                                   tile(hof.trees.length)),
+                    ),
+                    cost=sel(pops.cost,
+                             tile(jnp.where(exists, hof.cost, jnp.inf))),
+                    loss=sel(pops.loss, tile(hof.loss)),
+                    complexity=sel(pops.complexity, tile(hof.complexity)),
+                    birth=jnp.where(mask[:, None], fresh_ticks, pops.birth),
+                    parent=jnp.where(
+                        mask[:, None],
+                        jnp.full_like(pops.parent, -1), pops.parent),
+                    ref=jnp.where(mask[:, None], fresh_ticks, pops.ref),
+                    params=sel(pops.params, tile(hof.params)),
+                )
+                bump = mask.astype(jnp.int32) * jnp.int32(P)
+                return dataclasses.replace(
+                    state, pops=new_pops,
+                    birth=state.birth + bump, ref=state.ref + bump,
+                )
+
+            self._reseed_jit = jax.jit(reseed)
+        return self._reseed_jit(state, mask)
 
 
 def _migrate(key, pops: PopulationState, pool: PopulationState, frac: float,

@@ -663,3 +663,73 @@ def check_stray_debug(mod: ModuleAnalysis) -> Iterator[Finding]:
                 f"into the compiled program; guard it behind a debug "
                 f"flag or move it to a debug module",
             )
+
+
+# ---------------------------------------------------------------------------
+# GL007 — device/IO work inside a signal handler (graftshield)
+# ---------------------------------------------------------------------------
+
+# Dotted-name prefixes that mean "this handler touches the device, the
+# filesystem, or heavyweight serialization" — none of which is
+# async-signal-safe, and a jax call from a handler that interrupted the
+# runtime can deadlock the process it was meant to preempt gracefully.
+_SIGNAL_HAZARD_PREFIXES = (
+    "jax.", "jnp.", "np.", "numpy.", "pickle.", "json.",
+)
+_SIGNAL_HAZARD_NAMES = {
+    "open", "float", "int", "device_get", "block_until_ready",
+    "save_search_state", "load_search_state",
+}
+
+
+def _signal_handler_names(mod: ModuleAnalysis) -> Set[str]:
+    """Function/method names registered via `signal.signal(sig, fn)`."""
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted_name(node.func) != "signal.signal" or len(node.args) < 2:
+            continue
+        handler = node.args[1]
+        if isinstance(handler, ast.Name):
+            out.add(handler.id)
+        elif isinstance(handler, ast.Attribute):
+            out.add(handler.attr)
+    return out
+
+
+@rule(
+    "GL007",
+    "signal-unsafe-handler",
+    "device sync / IO / serialization inside a signal handler",
+    "A signal handler runs at an arbitrary bytecode boundary — possibly "
+    "inside the XLA runtime or mid-checkpoint. jax calls, device syncs, "
+    "pickling, or file writes from it can deadlock or corrupt the very "
+    "state graftshield exists to save. Handlers must only set flags "
+    "(threading.Event / attributes); the emergency checkpoint happens "
+    "later, at the iteration boundary, on the main thread "
+    "(shield/signals.py is the reference implementation).",
+)
+def check_signal_unsafe_handler(mod: ModuleAnalysis) -> Iterator[Finding]:
+    handlers = _signal_handler_names(mod)
+    if not handlers:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, FUNC_NODES) or node.name not in handlers:
+            continue
+        for inner in ast.walk(node):
+            if not isinstance(inner, ast.Call):
+                continue
+            dn = dotted_name(inner.func)
+            if dn is None:
+                continue
+            last = dn.rsplit(".", 1)[-1]
+            if dn.startswith(_SIGNAL_HAZARD_PREFIXES) or (
+                dn in _SIGNAL_HAZARD_NAMES or last in _SIGNAL_HAZARD_NAMES
+            ):
+                yield _finding(
+                    mod, "GL007", inner,
+                    f"`{dn}` inside signal handler `{node.name}` — "
+                    f"handlers must only set flags; do the work at the "
+                    f"next iteration boundary",
+                )

@@ -1,0 +1,55 @@
+"""graftshield — the fault-tolerant search runtime (docs/ROBUSTNESS.md).
+
+A supervision layer wrapped around the ``equation_search`` host loop and
+``Engine.run_iteration``, with four pillars:
+
+1. **Preemption-safe checkpointing** (:mod:`.signals`,
+   :mod:`.checkpoints`): SIGTERM/SIGINT set a flag that forces an
+   emergency checkpoint at the next iteration boundary; checkpoints roll
+   (last-K, digest-verified on write) and ``equation_search(resume="auto")``
+   discovers and falls back to the newest *valid* one.
+2. **Watchdog deadlines** (:mod:`.watchdog`): a host-side thread detects
+   a hung device dispatch or runaway compile against per-phase budgets
+   (``Options(iteration_deadline, compile_budget)``) and aborts with a
+   diagnostic dump instead of hanging until an external ``timeout``
+   kills the job (the rc=124 failure mode of MULTICHIP_r05).
+3. **Graceful degradation** (:mod:`.degrade`, :mod:`.quarantine`):
+   transient ``RESOURCE_EXHAUSTED``/compile-cache failures retry with
+   bounded exponential backoff, then step the eval tile rows down
+   instead of crashing; a NaN-storm-collapsed island is quarantined —
+   reseeded from hall-of-fame entries in-graph — and the search keeps
+   going.
+4. **Deterministic fault injection** (:mod:`.faults`): raise-on-Nth-
+   dispatch, NaN-poison-island-i, SIGTERM-at-iteration-k, checkpoint
+   corruption, simulated OOM — the test suite and the CI
+   ``fault-injection-smoke`` job pin every recovery path with it.
+
+Every fault, retry, degradation, and quarantine event flows into the
+graftscope JSONL stream as a ``fault`` record (telemetry/schema.py), so
+recoveries are auditable per-run.
+"""
+
+from .checkpoints import (
+    RollingCheckpointer,
+    discover_resume_path,
+    load_newest_valid,
+)
+from .degrade import ShieldRunner, is_transient_failure
+from .faults import FaultInjector, FaultPlan, InjectedFault, active_injector
+from .signals import PreemptionGuard
+from .watchdog import Watchdog, WatchdogTimeout
+
+__all__ = [
+    "RollingCheckpointer",
+    "discover_resume_path",
+    "load_newest_valid",
+    "ShieldRunner",
+    "is_transient_failure",
+    "FaultInjector",
+    "FaultPlan",
+    "InjectedFault",
+    "active_injector",
+    "PreemptionGuard",
+    "Watchdog",
+    "WatchdogTimeout",
+]

@@ -1,0 +1,186 @@
+"""Rolling, discoverable, corruption-tolerant checkpoint management.
+
+``api/checkpoint.py`` owns the on-disk format (digest-verified envelope,
+multi-host rank shards); this module owns the *policy* around it:
+
+- :class:`RollingCheckpointer` keeps the last K checkpoints
+  (``search_state.pkl``, ``.1``, ``.2``, ...), rotating before each
+  write so a torn write or a corrupt newest file never strands the run
+  — and rotates the multi-host ``.rank{k}`` files as a set.
+- :func:`load_newest_valid` walks a candidate list newest-first,
+  skipping (with a warning) files that raise
+  :class:`~..api.checkpoint.CheckpointCorruptError`.
+- :func:`discover_resume_path` implements ``equation_search(resume="auto")``:
+  find the newest run directory under the output base that contains a
+  checkpoint set.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import List, Optional, Tuple
+
+from ..api.checkpoint import (
+    CheckpointCorruptError,
+    load_search_state,
+    rank_shard_paths,
+    save_search_state,
+)
+
+__all__ = [
+    "RollingCheckpointer",
+    "rolled_paths",
+    "load_newest_valid",
+    "discover_resume_path",
+]
+
+CHECKPOINT_BASENAME = "search_state.pkl"
+
+
+def rolled_paths(base: str, keep: int) -> List[str]:
+    """Newest-first candidate paths for a rolling set of size ``keep``."""
+    return [base] + [f"{base}.{n}" for n in range(1, keep)]
+
+
+def _files_for(path: str) -> List[str]:
+    """All on-disk files belonging to one checkpoint slot: the base file
+    (single-host) and/or its rank shards (multi-host)."""
+    out = [path] if os.path.exists(path) else []
+    out.extend(rank_shard_paths(path))
+    return out
+
+
+class RollingCheckpointer:
+    """Writes ``base`` and keeps the previous ``keep - 1`` generations.
+
+    Rotation happens *before* the new write: ``base.{K-2}`` →
+    ``base.{K-1}`` → ... → ``base`` is about to be replaced, so its old
+    content moves to ``base.1`` first. If the process dies mid-write,
+    ``base.1`` is still the complete previous state and
+    :func:`load_newest_valid` falls back to it.
+    """
+
+    def __init__(self, base: str, keep: int = 3) -> None:
+        self.base = base
+        self.keep = max(int(keep), 1)
+
+    def _own_files(self, path: str):
+        """The slot files THIS process owns. Multi-host: only this
+        rank's shard file — every rank runs the same rotation on a
+        shared filesystem, and racing os.replace on other ranks' files
+        would corrupt the set."""
+        import jax
+
+        if jax.process_count() > 1:
+            f = f"{path}.rank{jax.process_index()}"
+            return [f] if os.path.exists(f) else []
+        return _files_for(path)
+
+    def _rotate(self) -> None:
+        if self.keep == 1:
+            return
+        slots = rolled_paths(self.base, self.keep)
+        # drop the oldest generation's files, then shift each slot up
+        for f in self._own_files(slots[-1]):
+            try:
+                os.remove(f)
+            except OSError:  # pragma: no cover - racing cleanup
+                pass
+        for n in range(self.keep - 2, -1, -1):
+            src, dst = slots[n], slots[n + 1]
+            for f in self._own_files(src):
+                suffix = f[len(src):]  # "" or ".rank{k}"
+                try:
+                    os.replace(f, dst + suffix)
+                except OSError:  # pragma: no cover - racing cleanup
+                    pass
+
+    def save(self, state) -> str:
+        self._rotate()
+        save_search_state(self.base, state)
+        return self.base
+
+    def candidates(self) -> List[str]:
+        """Newest-first checkpoint slots that exist on disk."""
+        return [
+            p for p in rolled_paths(self.base, self.keep) if _files_for(p)
+        ]
+
+
+def load_newest_valid(paths: List[str], options,
+                      corrupt_log: Optional[List[Tuple[str, str]]] = None,
+                      ) -> Tuple[object, str]:
+    """Load the first checkpoint in ``paths`` (newest-first) that
+    survives digest verification and unpickling; corrupt candidates are
+    skipped with a warning and — when ``corrupt_log`` is passed —
+    recorded as ``(path, error)`` entries (the search loop turns those
+    into ``checkpoint_corrupt`` fault events; nothing else that happens
+    to warn during unpickling gets misreported). Raises the last
+    :class:`CheckpointCorruptError` when every candidate is bad, and
+    FileNotFoundError when the list is empty/absent."""
+    last_error: Optional[Exception] = None
+    tried = 0
+    for p in paths:
+        if not _files_for(p):
+            continue
+        tried += 1
+        try:
+            return load_search_state(p, options), p
+        except CheckpointCorruptError as e:
+            last_error = e
+            if corrupt_log is not None:
+                corrupt_log.append((p, str(e)))
+            warnings.warn(
+                f"checkpoint {p} is corrupt ({e}); falling back to the "
+                "previous rolling checkpoint",
+                stacklevel=2,
+            )
+    if tried == 0:
+        raise FileNotFoundError(
+            f"no checkpoint found among candidates: {paths}"
+        )
+    raise CheckpointCorruptError(
+        f"all {tried} checkpoint candidate(s) are corrupt; last error: "
+        f"{last_error}"
+    )
+
+
+def discover_resume_path(base_dir: str, keep: int = 8
+                         ) -> Optional[List[str]]:
+    """``resume="auto"`` discovery: newest-first checkpoint candidates
+    under ``base_dir``.
+
+    ``base_dir`` may be a run directory itself (contains
+    ``search_state.pkl`` / rank shards), or an output base whose run
+    subdirectories are scanned newest-mtime-first. Returns the candidate
+    path list for :func:`load_newest_valid`, or None when nothing
+    checkpoint-like exists."""
+    if not os.path.isdir(base_dir):
+        if _files_for(base_dir):  # a checkpoint file path directly
+            return rolled_paths(base_dir, keep)
+        return None
+
+    def run_candidates(d: str) -> List[str]:
+        base = os.path.join(d, CHECKPOINT_BASENAME)
+        return [p for p in rolled_paths(base, keep) if _files_for(p)]
+
+    direct = run_candidates(base_dir)
+    if direct:
+        return direct
+    runs = []
+    try:
+        entries = os.listdir(base_dir)
+    except OSError:
+        return None
+    for name in entries:
+        d = os.path.join(base_dir, name)
+        if not os.path.isdir(d):
+            continue
+        cands = run_candidates(d)
+        if cands:
+            runs.append((os.path.getmtime(cands[0]), cands))
+    if not runs:
+        return None
+    runs.sort(key=lambda t: -t[0])
+    return runs[0][1]

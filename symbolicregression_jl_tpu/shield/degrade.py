@@ -1,0 +1,126 @@
+"""Graceful degradation: transient-failure retries + eval-shape step-down.
+
+Transient device failures — ``RESOURCE_EXHAUSTED`` under memory
+pressure, compile-cache deserialization glitches, a preempted collective
+— should cost a bounded retry, not the whole search. The
+:class:`ShieldRunner` wraps each ``Engine.run_iteration`` call:
+
+1. transient failures retry with exponential backoff (base
+   ``Options(retry_backoff)``, doubling, capped) up to
+   ``Options(max_retries)`` times;
+2. when retries exhaust on an OOM-shaped failure, the eval tile rows
+   step down (``Engine.degrade_eval_tile_rows`` halves
+   ``cfg.eval_tile_rows`` and drops the compiled programs so the next
+   call re-lowers at the smaller launch geometry), the retry budget
+   resets, and the iteration re-runs;
+3. anything non-transient — or a run out of degradation headroom —
+   re-raises.
+
+Every retry/degrade emits a ``fault`` record into the graftscope stream
+so the recovery is auditable. Failure classification is by message
+substring: jaxlib's ``XlaRuntimeError`` carries the gRPC-style status
+name in its text, and the fault-injection harness raises exceptions with
+the same markers, so tests and production take the same path.
+
+Caveat (documented, not hidden): the single-launch iteration donates the
+input state buffers, so a failure that occurs *after* the runtime
+consumed them can poison the retry. In that case the retry itself fails
+with a buffer-deleted error, which is non-transient and surfaces
+immediately — recovery is then ``resume="auto"`` from the last rolling
+checkpoint, which is exactly what the shield keeps fresh.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+__all__ = ["ShieldRunner", "is_transient_failure", "TRANSIENT_MARKERS"]
+
+# Substrings (case-sensitive, matching XLA/gRPC status spellings) that
+# mark a failure as worth retrying. Buffer-deleted / donation errors are
+# deliberately NOT here: retrying them can only fail again.
+TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "UNAVAILABLE",
+    "ABORTED",
+    "DEADLINE_EXCEEDED",
+    "Failed to deserialize",   # persistent compile-cache glitch
+    "compilation cache",
+)
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED",)
+
+
+def is_transient_failure(exc: BaseException) -> bool:
+    msg = str(exc)
+    return any(m in msg for m in TRANSIENT_MARKERS)
+
+
+def _is_oom(exc: BaseException) -> bool:
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+class ShieldRunner:
+    """Retry/backoff + degradation supervisor for device dispatches."""
+
+    def __init__(
+        self,
+        *,
+        max_retries: int = 3,
+        backoff: float = 0.5,
+        backoff_cap: float = 30.0,
+        telemetry=None,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        self.max_retries = max(int(max_retries), 0)
+        self.backoff = max(float(backoff), 0.0)
+        self.backoff_cap = float(backoff_cap)
+        self.telemetry = telemetry
+        self._sleep = sleep
+        self.retries_total = 0
+        self.degrades_total = 0
+
+    def _fault(self, kind: str, iteration: int, **detail) -> None:
+        if self.telemetry is not None:
+            self.telemetry.fault(kind, iteration=iteration, **detail)
+
+    def run(self, fn: Callable[[], object], *, iteration: int = 0,
+            engine=None, output: int = 1):
+        """Run ``fn`` (one full device iteration, including the blocking
+        sync) under the retry/degrade policy."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 - classified below
+                if not is_transient_failure(e):
+                    raise
+                attempt += 1
+                if attempt <= self.max_retries:
+                    delay = min(
+                        self.backoff * (2.0 ** (attempt - 1)),
+                        self.backoff_cap,
+                    )
+                    self.retries_total += 1
+                    self._fault(
+                        "retry", iteration, output=output,
+                        attempt=attempt, max_retries=self.max_retries,
+                        delay_s=delay, error=str(e)[:500],
+                    )
+                    if delay > 0:
+                        self._sleep(delay)
+                    continue
+                # Retries exhausted: try stepping the eval launch down.
+                new_rows = None
+                if engine is not None and _is_oom(e):
+                    new_rows = engine.degrade_eval_tile_rows()
+                if new_rows is None:
+                    raise
+                attempt = 0
+                self.degrades_total += 1
+                self._fault(
+                    "degrade", iteration, output=output,
+                    eval_tile_rows=new_rows, error=str(e)[:500],
+                )

@@ -1,0 +1,191 @@
+"""Deterministic fault injection — the harness the recovery tests drive.
+
+Every graftshield recovery path is pinned by injecting the fault it
+exists for, at an exact, reproducible point in the search:
+
+- ``raise_on_dispatch=n`` — the n-th supervised device dispatch raises
+  :class:`InjectedFault` (message carries ``RESOURCE_EXHAUSTED`` or any
+  marker you choose, so the transient classifier and the degradation
+  ladder take their production paths). ``raise_count`` consecutive
+  dispatches fail, then the fault clears — retries succeed.
+- ``sigterm_at_iteration=k`` — delivers a real SIGTERM to this process
+  at the end of iteration k (the PreemptionGuard path, end to end).
+- ``nan_poison_island=(i, k)`` — at the end of iteration k, island i's
+  constants/costs/losses are overwritten with NaN in-graph: a genuine
+  NaN storm (subsequent re-evals of the poisoned genomes stay NaN),
+  which the quarantine must detect and reseed.
+- checkpoint corruption helpers (:func:`truncate_file`,
+  :func:`flip_byte`) — applied to written checkpoints by tests to pin
+  the digest-verification + rolling-fallback machinery.
+
+Injection is process-local: tests call :func:`install`; headless smoke
+runs set ``SR_FAULT_PLAN`` to the plan as JSON. The search loop polls
+:func:`active_injector` once per search. No injector, no overhead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+from typing import Optional, Tuple
+
+__all__ = [
+    "FaultPlan",
+    "FaultInjector",
+    "InjectedFault",
+    "install",
+    "clear",
+    "active_injector",
+    "truncate_file",
+    "flip_byte",
+]
+
+
+class InjectedFault(RuntimeError):
+    """An injected device failure. The *message* is the classification
+    surface (shield/degrade.py matches status markers in text, same as
+    for real jaxlib XlaRuntimeErrors)."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A deterministic schedule of faults for one search."""
+
+    # n-th supervised dispatch (1-based, counted across outputs) raises.
+    raise_on_dispatch: Optional[int] = None
+    raise_count: int = 1
+    raise_message: str = "RESOURCE_EXHAUSTED: injected device OOM"
+    # Real SIGTERM to this process at the end of iteration k (1-based).
+    sigterm_at_iteration: Optional[int] = None
+    # (island, iteration): poison island i at the end of iteration k.
+    nan_poison_island: Optional[Tuple[int, int]] = None
+
+    @staticmethod
+    def from_json(text: str) -> "FaultPlan":
+        d = json.loads(text)
+        if "nan_poison_island" in d and d["nan_poison_island"] is not None:
+            d["nan_poison_island"] = tuple(d["nan_poison_island"])
+        return FaultPlan(**d)
+
+
+class FaultInjector:
+    """Stateful executor of a :class:`FaultPlan` for one search run."""
+
+    def __init__(self, plan: FaultPlan, telemetry=None) -> None:
+        self.plan = plan
+        self.telemetry = telemetry
+        self.dispatches = 0
+        self.injected = []  # audit trail of (kind, detail) tuples
+
+    def _record(self, kind: str, iteration: int, **detail) -> None:
+        self.injected.append((kind, detail))
+        if self.telemetry is not None:
+            self.telemetry.fault(
+                "injected", iteration=iteration, fault=kind, **detail
+            )
+
+    # -- hook: immediately before each supervised device dispatch -------
+    def on_dispatch(self, iteration: int) -> None:
+        self.dispatches += 1
+        p = self.plan
+        if p.raise_on_dispatch is None:
+            return
+        first = p.raise_on_dispatch
+        if first <= self.dispatches < first + p.raise_count:
+            self._record("raise_on_dispatch", iteration,
+                         dispatch=self.dispatches)
+            raise InjectedFault(p.raise_message)
+
+    # -- hook: after iteration k's device work landed -------------------
+    def on_iteration_end(self, iteration: int, states: list) -> list:
+        p = self.plan
+        if p.nan_poison_island is not None:
+            island, at_it = p.nan_poison_island
+            if iteration == at_it:
+                self._record("nan_poison_island", iteration, island=island)
+                states = [poison_island(s, island) for s in states]
+        if p.sigterm_at_iteration == iteration:
+            self._record("sigterm", iteration)
+            os.kill(os.getpid(), signal.SIGTERM)
+        return states
+
+
+def poison_island(state, island: int):
+    """A genuine in-graph NaN storm on one island: constants, costs, and
+    losses all go NaN, so even a full-dataset re-eval of the poisoned
+    genomes stays non-finite (what a real numerical collapse looks like
+    from the host)."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    pops = state.pops
+    nan = jnp.asarray(float("nan"), pops.trees.const.dtype)
+    trees = dc.replace(
+        pops.trees, const=pops.trees.const.at[island].set(nan)
+    )
+    pops = dc.replace(
+        pops,
+        trees=trees,
+        cost=pops.cost.at[island].set(jnp.asarray(float("nan"),
+                                                  pops.cost.dtype)),
+        loss=pops.loss.at[island].set(jnp.asarray(float("nan"),
+                                                  pops.loss.dtype)),
+    )
+    return dc.replace(state, pops=pops)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint corruption helpers (tests + fault smoke)
+# ---------------------------------------------------------------------------
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5) -> None:
+    size = os.path.getsize(path)
+    with open(path, "rb+") as f:
+        f.truncate(max(int(size * keep_fraction), 0))
+
+
+def flip_byte(path: str, offset: int = -64) -> None:
+    """XOR one byte (negative offsets index from the end, where the
+    payload bytes — not the envelope header — live)."""
+    size = os.path.getsize(path)
+    pos = offset % size
+    with open(path, "rb+") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+# ---------------------------------------------------------------------------
+# Process-local installation
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    global _ACTIVE
+    _ACTIVE = injector
+    return injector
+
+
+def clear() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active_injector(telemetry=None) -> Optional[FaultInjector]:
+    """The injector the current search should consult: an installed one,
+    else one built from ``SR_FAULT_PLAN`` (JSON) if set, else None."""
+    if _ACTIVE is not None:
+        if telemetry is not None and _ACTIVE.telemetry is None:
+            _ACTIVE.telemetry = telemetry
+        return _ACTIVE
+    env = os.environ.get("SR_FAULT_PLAN")
+    if env:
+        return FaultInjector(FaultPlan.from_json(env), telemetry=telemetry)
+    return None

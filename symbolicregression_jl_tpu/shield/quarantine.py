@@ -1,0 +1,70 @@
+"""Island quarantine: detect and reseed NaN-storm-collapsed islands.
+
+An island whose members are all non-finite is blind: tournament
+selection cannot rank candidates, every mutation child of a NaN-constant
+parent is NaN, and the island burns its share of every eval launch for
+the rest of the run producing nothing (graftscope shows it as a
+saturated invalid-candidate fraction and an emptying loss histogram).
+The quarantine reseeds such islands from the hall of fame — entirely
+in-graph (``Engine.reseed_islands``) — and the search keeps going.
+
+Detection is host-side: one tiny jitted reduction
+(``Engine.island_invalid_fractions`` → an [I] float vector) per check,
+pulled explicitly. That is the only traffic the feature adds, it rides
+the per-iteration sync the loop already performs, and it never runs
+inside the hot jitted iteration itself — the warm-iteration guarantees
+(0 retraces / 0 implicit transfers, tests/test_hot_loop_guards.py) are
+untouched.
+
+The default threshold is 1.0 — only a *fully* collapsed island
+quarantines, so healthy searches (where early random populations
+legitimately carry some non-finite members) are bit-identical with the
+feature on or off until a genuine storm hits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["IslandQuarantine"]
+
+
+class IslandQuarantine:
+    """Per-output quarantine policy driver for the search loop."""
+
+    def __init__(self, threshold: float = 1.0, telemetry=None) -> None:
+        self.threshold = float(threshold)
+        self.telemetry = telemetry
+        self.reseeds_total = 0
+
+    def check_and_reseed(self, engine, state, *, iteration: int = 0,
+                         output: int = 1):
+        """Returns (possibly reseeded) state. Cheap when healthy: one
+        [I]-vector pull; the in-graph reseed only dispatches when at
+        least one island crossed the threshold AND the hall of fame has
+        at least one entry to reseed from."""
+        import jax
+
+        fracs = np.asarray(
+            jax.device_get(engine.island_invalid_fractions(state))
+        )
+        mask = fracs >= self.threshold
+        if not mask.any():
+            return state
+        if not bool(np.asarray(jax.device_get(state.hof.exists)).any()):
+            # Nothing to reseed from yet (a storm before the first HoF
+            # entry): leave the island alone; evolution's randomize
+            # mutations are the only way out.
+            return state
+        self.reseeds_total += int(mask.sum())
+        if self.telemetry is not None:
+            self.telemetry.fault(
+                "quarantine", iteration=iteration, output=output,
+                islands=[int(i) for i in np.nonzero(mask)[0]],
+                invalid_fractions=[round(float(f), 4) for f in fracs],
+            )
+        import jax.numpy as jnp
+
+        return engine.reseed_islands(state, jnp.asarray(mask))

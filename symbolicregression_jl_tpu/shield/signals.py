@@ -1,0 +1,102 @@
+"""Preemption signal capture: turn SIGTERM/SIGINT into a graceful stop.
+
+TPU preemption and maintenance events deliver SIGTERM with a short grace
+window; a bare SIGTERM kills the process mid-iteration and loses
+everything since the last periodic checkpoint. The guard converts the
+signal into a flag the search loop polls at iteration boundaries
+(``_budget_stop``), which then stops with ``stop_reason="preempted"``
+and writes the emergency checkpoint through the normal end-of-loop path
+— the state written is exactly the state an uninterrupted run would
+have had at that boundary, which is what makes ``resume="auto"``
+bit-identical (tests/test_shield.py).
+
+Signal-handler discipline (enforced by graftlint rule GL007): the
+handler bodies below only record which signal arrived and set a
+``threading.Event`` — no jax calls, no device syncs, no file IO, no
+allocation-heavy work. Everything else (the checkpoint itself, fault
+telemetry) happens later, on the main thread, at the iteration boundary.
+
+A second SIGINT (the user leaning on ctrl-C because the current device
+dispatch is long) re-raises ``KeyboardInterrupt`` so the process can
+still be torn down the classic way.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+__all__ = ["PreemptionGuard"]
+
+
+class PreemptionGuard:
+    """Installs SIGTERM/SIGINT handlers for the duration of a search.
+
+    Only installable from the main thread (a Python limitation);
+    elsewhere — e.g. a search running inside a worker thread of a
+    service — ``install`` is a recorded no-op and the surrounding
+    service owns signal policy.
+    """
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._signum: Optional[int] = None
+        self._int_count = 0
+        self._prev: dict = {}
+        self.installed = False
+
+    # -- handlers (GL007: flag-set only; see module docstring) ----------
+    def _on_sigterm(self, signum, frame) -> None:
+        self._signum = signum
+        self._event.set()
+
+    def _on_sigint(self, signum, frame) -> None:
+        self._int_count += 1
+        self._signum = signum
+        self._event.set()
+        if self._int_count >= 2:
+            raise KeyboardInterrupt
+
+    # -------------------------------------------------------------------
+    def install(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        try:
+            self._prev[signal.SIGTERM] = signal.signal(
+                signal.SIGTERM, self._on_sigterm)
+            self._prev[signal.SIGINT] = signal.signal(
+                signal.SIGINT, self._on_sigint)
+            self.installed = True
+        except (ValueError, OSError):  # non-main interpreter contexts
+            self.uninstall()
+        return self
+
+    def uninstall(self) -> None:
+        for signum, prev in self._prev.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._prev.clear()
+        self.installed = False
+
+    # -------------------------------------------------------------------
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def signal_name(self) -> Optional[str]:
+        if self._signum is None:
+            return None
+        try:
+            return signal.Signals(self._signum).name
+        except ValueError:  # pragma: no cover - exotic signum
+            return str(self._signum)
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

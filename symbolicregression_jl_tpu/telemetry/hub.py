@@ -191,6 +191,9 @@ class Telemetry:
         self._pending = False
         self._last_ctx: Optional[IterationContext] = None
         self._iterations_seen = 0
+        # graftshield fault audit: per-kind counts, always tracked (the
+        # run_end event reports them even at telemetry_interval > 1).
+        self.fault_counts: Dict[str, int] = {}
 
         self.path: Optional[str] = None
         enabled = bool(getattr(options, "telemetry", False))
@@ -232,6 +235,30 @@ class Telemetry:
     def add_sink(self, sink) -> "Telemetry":
         self._sinks.append(sink)
         return self
+
+    # ------------------------------------------------------------------
+    def fault(self, kind: str, *, iteration: int = 0,
+              **detail) -> Dict[str, Any]:
+        """Record a graftshield fault/recovery event (schema ``fault``).
+
+        Always cheap and never raises into the recovery path it audits:
+        counted in-process even when the JSONL stream is off, emitted to
+        the stream when it is on."""
+        event = {
+            "event": "fault",
+            "kind": str(kind),
+            "iteration": int(iteration),
+            "detail": {
+                k: v for k, v in detail.items() if v is not None
+            },
+        }
+        self.fault_counts[kind] = self.fault_counts.get(kind, 0) + 1
+        if self.path is not None:
+            try:
+                self._emit(event)
+            except OSError:  # auditing must not break the recovery
+                pass
+        return event
 
     def _emit(self, obj: Dict[str, Any]) -> None:
         obj = {"schema": SCHEMA_VERSION, "t": time.time(), **obj}
@@ -351,6 +378,9 @@ class Telemetry:
                     k: v for k, v in self._compiles.snapshot().items()
                     if k != "transfer_guard_hits"
                 },
+                # extra (schema-optional) field: per-kind graftshield
+                # fault counts for the whole run
+                "faults_total": dict(self.fault_counts),
             })
         summary = {
             "stop_reason": stop_reason,

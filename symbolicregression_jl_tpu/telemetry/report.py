@@ -37,6 +37,7 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
     run_start = next((e for e in events if e["event"] == "run_start"), None)
     run_end = next((e for e in events if e["event"] == "run_end"), None)
     iters = [e for e in events if e["event"] == "iteration"]
+    faults = [e for e in events if e["event"] == "fault"]
 
     summary: Dict[str, Any] = {"schema": SCHEMA_VERSION}
     if run_start is not None:
@@ -128,12 +129,28 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         outputs.append(agg)
     summary["outputs"] = outputs
 
+    # graftshield fault/recovery audit (docs/ROBUSTNESS.md): per-kind
+    # counts plus the raw timeline (kind, iteration) for small runs.
+    if faults:
+        by_kind: Dict[str, int] = {}
+        for e in faults:
+            by_kind[e["kind"]] = by_kind.get(e["kind"], 0) + 1
+        summary["faults"] = {
+            "count": len(faults),
+            "by_kind": by_kind,
+            "timeline": [[e["iteration"], e["kind"]] for e in faults[:50]],
+        }
+
     if run_end is not None:
         summary["end"] = {
             k: run_end.get(k)
             for k in ("stop_reason", "iterations", "num_evals", "elapsed_s",
                       "recompiles_total")
         }
+        if run_end.get("faults_total"):
+            summary.setdefault("faults", {})["totals_at_end"] = (
+                run_end["faults_total"]
+            )
     return summary
 
 
@@ -212,6 +229,17 @@ def format_report(summary: Dict[str, Any]) -> str:
                     "  reject reasons: "
                     + ", ".join(f"{k}={v:,}" for k, v in rej.items())
                 )
+    fl = summary.get("faults")
+    if fl:
+        kinds = ", ".join(
+            f"{k}={v}" for k, v in sorted(fl.get("by_kind", {}).items())
+        )
+        lines.append(
+            f"faults: {fl.get('count', 0)} event(s)"
+            + (f"  ({kinds})" if kinds else "")
+        )
+        for it_n, kind in fl.get("timeline", [])[:12]:
+            lines.append(f"  iter {it_n}: {kind}")
     end = summary.get("end")
     if end:
         lines.append(

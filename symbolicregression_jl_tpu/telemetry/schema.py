@@ -56,6 +56,16 @@ EVENT_SPECS: Dict[str, Dict[str, Any]] = {
         "elapsed_s": _NUM,
         "recompiles_total": dict,
     },
+    # graftshield fault/recovery audit records (docs/ROBUSTNESS.md):
+    # kind is one of preempt_signal / emergency_checkpoint / retry /
+    # degrade / quarantine / watchdog_timeout / checkpoint_corrupt /
+    # injected (fault harness); detail carries kind-specific fields
+    # (attempt counts, island lists, error text).
+    "fault": {
+        "kind": str,
+        "iteration": int,
+        "detail": dict,
+    },
 }
 
 # required keys inside each element of iteration.outputs; nullable

@@ -12,10 +12,32 @@ reference's "head node occupied" warning (:485-489).
 
 from __future__ import annotations
 
+import sys
+import threading
+import traceback
 from collections import deque
 from typing import Deque, Tuple
 
-__all__ = ["ResourceMonitor"]
+__all__ = ["ResourceMonitor", "thread_dump"]
+
+
+def thread_dump() -> str:
+    """Python stacks of every live thread, main thread first — the
+    graftshield watchdog's diagnostic payload (shield/watchdog.py). A
+    dispatch hung inside the XLA runtime shows up as the main thread
+    blocked in ``block_until_ready`` (or a specific jitted call), which
+    is exactly the attribution an external ``timeout`` kill loses."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    main_id = threading.main_thread().ident
+    frames = sys._current_frames()
+    order = sorted(frames, key=lambda tid: (tid != main_id, tid))
+    chunks = []
+    for tid in order:
+        name = names.get(tid, "?")
+        tag = " (main)" if tid == main_id else ""
+        stack = "".join(traceback.format_stack(frames[tid]))
+        chunks.append(f"--- thread {name}{tag} [{tid}] ---\n{stack}")
+    return "".join(chunks)
 
 
 class ResourceMonitor:

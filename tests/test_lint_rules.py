@@ -461,6 +461,88 @@ def test_gl006_clean_behind_debug_guard_or_debug_function():
 
 
 # ---------------------------------------------------------------------------
+# GL007 signal-unsafe-handler (graftshield emergency-checkpoint path)
+# ---------------------------------------------------------------------------
+
+
+def test_gl007_flags_device_sync_in_signal_handler():
+    findings = _lint(
+        """
+        import signal
+        import jax
+
+        class Guard:
+            def _on_sigterm(self, signum, frame):
+                jax.device_get(self.state)
+
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_sigterm)
+        """,
+        path="pkg/shield/bad_signals.py",
+    )
+    assert "GL007" in _ids(findings)
+
+
+def test_gl007_flags_checkpoint_write_in_handler():
+    findings = _lint(
+        """
+        import signal
+
+        def _handler(signum, frame):
+            save_search_state("out.pkl", STATE)
+
+        signal.signal(signal.SIGTERM, _handler)
+        """,
+        path="pkg/shield/bad2.py",
+    )
+    assert "GL007" in _ids(findings)
+
+
+def test_gl007_clean_flag_only_handler():
+    findings = _lint(
+        """
+        import signal
+        import threading
+
+        class Guard:
+            def __init__(self):
+                self._event = threading.Event()
+                self._signum = None
+
+            def _on_sigterm(self, signum, frame):
+                self._signum = signum
+                self._event.set()
+
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_sigterm)
+        """,
+        path="pkg/shield/good_signals.py",
+    )
+    assert "GL007" not in _ids(findings)
+
+
+def test_gl007_nonhandler_functions_untouched():
+    # The same hazardous calls OUTSIDE a registered handler are fine
+    # (GL007 is about signal context, not the calls themselves).
+    findings = _lint(
+        """
+        import signal
+        import jax
+
+        def _handler(signum, frame):
+            FLAG.append(signum)
+
+        def checkpoint(state):
+            return jax.device_get(state)
+
+        signal.signal(signal.SIGTERM, _handler)
+        """,
+        path="pkg/shield/mixed.py",
+    )
+    assert "GL007" not in _ids(findings)
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 

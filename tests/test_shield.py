@@ -1,0 +1,334 @@
+"""graftshield recovery paths, pinned via the fault-injection harness.
+
+The headline contract (ISSUE 9 acceptance): kill -TERM mid-search, then
+``equation_search(resume="auto")`` → final hall of fame **bit-identical**
+to the uninterrupted run. Plus: watchdog deadlines fire with a
+diagnostic dump, transient failures retry with backoff, OOM-shaped
+failures step the eval launch geometry down, and a NaN-storm-collapsed
+island is quarantined and reseeded from the hall of fame.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.api.search import RuntimeOptions
+from symbolicregression_jl_tpu.shield import faults
+from symbolicregression_jl_tpu.shield.degrade import (
+    ShieldRunner,
+    is_transient_failure,
+)
+from symbolicregression_jl_tpu.shield.watchdog import Watchdog, WatchdogTimeout
+
+
+def _problem(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, (n, 2)).astype(np.float32)
+    y = (2.0 * X[:, 0] + X[:, 1] * X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options(tmp_path, **kw):
+    # Same shapes as tests/test_checkpoint.py (shared compile cache).
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=[],
+        maxsize=10,
+        populations=2,
+        population_size=12,
+        tournament_selection_n=4,
+        ncycles_per_iteration=4,
+        save_to_file=True,
+        output_directory=str(tmp_path),
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+@pytest.fixture(autouse=True)
+def _clear_injector():
+    yield
+    faults.clear()
+
+
+def _faults_in(run_dir):
+    path = os.path.join(run_dir, "telemetry.jsonl")
+    with open(path) as f:
+        return [json.loads(l) for l in f if '"fault"' in l]
+
+
+# ---------------------------------------------------------------------------
+# preemption: SIGTERM -> emergency checkpoint -> resume bit-identical
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # 3 full searches; CI replays this exact scenario in
+# the fault-injection-smoke job (tools/fault_smoke.py scenario 1)
+def test_sigterm_resume_auto_bit_identical(tmp_path):
+    X, y = _problem()
+    ropt = lambda run_id, seed=7: RuntimeOptions(  # noqa: E731
+        niterations=4, run_id=run_id, seed=seed, verbosity=0)
+
+    # A: uninterrupted 4-iteration reference
+    dir_a = tmp_path / "a"
+    sA, _ = equation_search(
+        X, y, options=_options(dir_a), runtime_options=ropt("ref"),
+        return_state=True)
+
+    # B: a real SIGTERM lands at the end of iteration 2 -> graceful stop
+    dir_b = tmp_path / "b"
+    faults.install(faults.FaultInjector(
+        faults.FaultPlan(sigterm_at_iteration=2)))
+    equation_search(X, y, options=_options(dir_b, telemetry=True),
+                    runtime_options=ropt("pre"))
+    faults.clear()
+    evs = _faults_in(os.path.join(dir_b, "pre"))
+    kinds = {e["kind"] for e in evs}
+    assert {"injected", "preempt_signal", "emergency_checkpoint"} <= kinds
+    tel = [json.loads(l) for l in open(
+        os.path.join(dir_b, "pre", "telemetry.jsonl"))]
+    end = next(e for e in tel if e["event"] == "run_end")
+    assert end["stop_reason"] == "preempted"
+    assert end["iterations"] == 2
+
+    # C: resume="auto" discovers B's checkpoint, runs iterations 3..4
+    sC, _ = equation_search(
+        X, y, options=_options(dir_b), resume="auto",
+        runtime_options=ropt("res", seed=99),  # seed must NOT matter
+        return_state=True)
+    assert sC.iterations_done == 4
+
+    a0, c0 = sA.device_states[0], sC.device_states[0]
+    for f in ("arity", "op", "feat", "const", "length"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a0.hof.trees, f)),
+            np.asarray(getattr(c0.hof.trees, f)), err_msg=f"hof {f}")
+    np.testing.assert_array_equal(np.asarray(a0.hof.cost),
+                                  np.asarray(c0.hof.cost))
+    np.testing.assert_array_equal(np.asarray(a0.pops.cost),
+                                  np.asarray(c0.pops.cost))
+    assert sC.num_evals == pytest.approx(sA.num_evals, rel=1e-6)
+
+
+def test_resume_auto_without_checkpoint_starts_fresh(tmp_path, capsys):
+    X, y = _problem()
+    hof = equation_search(
+        X, y, options=_options(tmp_path / "empty", save_to_file=False),
+        resume="auto",
+        runtime_options=RuntimeOptions(niterations=1, seed=0, verbosity=1),
+    )
+    assert len(hof.entries) > 0
+    assert "starting fresh" in capsys.readouterr().out
+
+
+def test_resume_and_saved_state_are_mutually_exclusive(tmp_path):
+    X, y = _problem()
+    with pytest.raises(ValueError, match="not both"):
+        equation_search(
+            X, y, options=_options(tmp_path, save_to_file=False),
+            resume="auto", saved_state="whatever.pkl",
+            runtime_options=RuntimeOptions(niterations=1, verbosity=0),
+        )
+
+
+# ---------------------------------------------------------------------------
+# retry / degradation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # full-search variant; the retry/degrade mechanics are
+# pinned fast by test_retry_exhaustion_degrades_eval_tile_rows below
+def test_transient_dispatch_failure_retries_and_recovers(tmp_path):
+    X, y = _problem()
+    faults.install(faults.FaultInjector(
+        faults.FaultPlan(raise_on_dispatch=2)))
+    hof = equation_search(
+        X, y,
+        options=_options(tmp_path, telemetry=True, retry_backoff=0.01),
+        runtime_options=RuntimeOptions(
+            niterations=2, run_id="retry", seed=1, verbosity=0),
+    )
+    assert len(hof.entries) > 0
+    evs = _faults_in(os.path.join(tmp_path, "retry"))
+    retries = [e for e in evs if e["kind"] == "retry"]
+    assert len(retries) == 1
+    assert retries[0]["detail"]["attempt"] == 1
+
+
+def test_nontransient_failure_raises_immediately(tmp_path):
+    X, y = _problem()
+    faults.install(faults.FaultInjector(faults.FaultPlan(
+        raise_on_dispatch=1,
+        raise_message="INVALID_ARGUMENT: genuinely broken")))
+    with pytest.raises(faults.InjectedFault, match="INVALID_ARGUMENT"):
+        equation_search(
+            X, y, options=_options(tmp_path, save_to_file=False),
+            runtime_options=RuntimeOptions(niterations=1, seed=1,
+                                           verbosity=0),
+        )
+
+
+def test_retry_exhaustion_degrades_eval_tile_rows():
+    from symbolicregression_jl_tpu import make_dataset
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+
+    X, y = _problem(64)
+    opts = Options(binary_operators=["+", "*"], unary_operators=[],
+                   maxsize=8, populations=2, population_size=8,
+                   tournament_selection_n=4, ncycles_per_iteration=2,
+                   eval_tile_rows=2048, save_to_file=False)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(opts.elementwise_loss)
+    engine = Engine(opts, ds.nfeatures)
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 3:  # 1 try + 2 retries all OOM -> degrade
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+        return "ok"
+
+    runner = ShieldRunner(max_retries=2, backoff=0.0)
+    assert runner.run(flaky, engine=engine) == "ok"
+    assert runner.retries_total == 2
+    assert runner.degrades_total == 1
+    assert engine.cfg.eval_tile_rows == 1024
+
+    # Ladder floor: a persistent OOM eventually surfaces.
+    runner2 = ShieldRunner(max_retries=0, backoff=0.0)
+
+    def always_oom():
+        raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        runner2.run(always_oom, engine=engine)
+    assert engine.cfg.eval_tile_rows == 512  # degraded to the floor first
+
+
+def test_transient_classifier():
+    assert is_transient_failure(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    assert is_transient_failure(RuntimeError("UNAVAILABLE: link down"))
+    assert is_transient_failure(
+        RuntimeError("Failed to deserialize cache entry"))
+    assert not is_transient_failure(RuntimeError("INVALID_ARGUMENT: shape"))
+    assert not is_transient_failure(
+        RuntimeError("Array has been deleted (donated)"))
+
+
+# ---------------------------------------------------------------------------
+# quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_nan_storm_island_is_quarantined(tmp_path):
+    X, y = _problem()
+    faults.install(faults.FaultInjector(
+        faults.FaultPlan(nan_poison_island=(0, 1))))
+    state, hof = equation_search(
+        X, y, options=_options(tmp_path, telemetry=True),
+        runtime_options=RuntimeOptions(
+            niterations=3, run_id="qrt", seed=1, verbosity=0),
+        return_state=True)
+    evs = _faults_in(os.path.join(tmp_path, "qrt"))
+    q = [e for e in evs if e["kind"] == "quarantine"]
+    assert q and q[0]["detail"]["islands"] == [0]
+    # The reseeded island is alive again: finite members exist and the
+    # search kept going to the target.
+    loss = np.asarray(state.device_states[0].pops.loss)
+    assert np.isfinite(loss[0]).mean() > 0.5
+    assert len(hof.entries) > 0
+
+
+@pytest.mark.slow  # negative-control search; the positive quarantine
+# path stays in the fast tier above
+def test_quarantine_off_leaves_storm_alone(tmp_path):
+    X, y = _problem()
+    faults.install(faults.FaultInjector(
+        faults.FaultPlan(nan_poison_island=(0, 2))))
+    state, _ = equation_search(
+        X, y,
+        options=_options(tmp_path, save_to_file=False,
+                         island_quarantine=False),
+        runtime_options=RuntimeOptions(niterations=2, seed=1, verbosity=0),
+        return_state=True)
+    loss = np.asarray(state.device_states[0].pops.loss)
+    assert not np.isfinite(loss[0]).any()
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_fires_with_diagnostic_dump(tmp_path):
+    import time
+
+    dumps = []
+    wd = Watchdog(on_timeout=dumps.append, poll_interval=0.02,
+                  dump_path=str(tmp_path / "dump.txt"))
+    with pytest.raises(WatchdogTimeout, match="hang-phase"):
+        with wd.phase("hang-phase", budget=0.05, iteration=3):
+            time.sleep(0.5)
+    wd.stop()
+    assert len(dumps) == 1
+    dump = dumps[0]
+    assert "hang-phase" in dump and "iteration  : 3" in dump
+    assert "(main)" in dump  # the blocked thread's stack is attributed
+    assert "test_watchdog_fires_with_diagnostic_dump" in dump
+    assert os.path.exists(tmp_path / "dump.txt")
+
+
+def test_watchdog_quiet_within_budget():
+    wd = Watchdog(on_timeout=lambda d: pytest.fail("fired"),
+                  poll_interval=0.02)
+    for i in range(3):
+        with wd.phase("fast", budget=5.0, iteration=i):
+            pass
+    wd.stop()
+    assert not wd.fired
+
+
+def test_watchdog_unbudgeted_phase_is_noop():
+    import time
+
+    wd = Watchdog(on_timeout=lambda d: pytest.fail("fired"))
+    with wd.phase("unsupervised", budget=None):
+        time.sleep(0.05)
+    wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# signals / plan plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_guard_sets_flag_and_restores_handlers():
+    import signal
+
+    from symbolicregression_jl_tpu.shield.signals import PreemptionGuard
+
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as g:
+        assert g.installed
+        assert not g.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert g.requested
+        assert g.signal_name == "SIGTERM"
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_fault_plan_env_roundtrip(monkeypatch):
+    plan = faults.FaultPlan(raise_on_dispatch=3, raise_count=2,
+                            nan_poison_island=(1, 4))
+    text = json.dumps({
+        "raise_on_dispatch": 3, "raise_count": 2,
+        "nan_poison_island": [1, 4],
+    })
+    assert faults.FaultPlan.from_json(text) == plan
+    monkeypatch.setenv("SR_FAULT_PLAN", text)
+    inj = faults.active_injector()
+    assert inj is not None and inj.plan == plan

@@ -1,0 +1,298 @@
+"""Checkpoint round-trips under adversity (graftshield, docs/ROBUSTNESS.md).
+
+Extends the corruption-mode precedent of tests/test_encoding_invariants.py
+to the on-disk state: truncation at several points, flipped bytes, stale
+format versions, rolling-K pruning, newest-valid fallback, and the
+multi-host rank-shard reassembly helpers — every failure must surface as
+:class:`CheckpointCorruptError` (never a raw unpickling crash), and the
+fallback machinery must recover whenever ANY valid generation survives.
+"""
+
+import dataclasses
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.api.checkpoint import (
+    CheckpointCorruptError,
+    load_search_state,
+    save_search_state,
+)
+from symbolicregression_jl_tpu.api.search import RuntimeOptions, SearchState
+from symbolicregression_jl_tpu.shield import faults
+from symbolicregression_jl_tpu.shield.checkpoints import (
+    RollingCheckpointer,
+    discover_resume_path,
+    load_newest_valid,
+    rolled_paths,
+)
+
+
+def _problem(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, (n, 2)).astype(np.float32)
+    y = (2.0 * X[:, 0] + X[:, 1] * X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options(tmp_path, **kw):
+    # Same shapes as tests/test_checkpoint.py so the compiled programs
+    # are shared across both files via the persistent test cache.
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=[],
+        maxsize=10,
+        populations=2,
+        population_size=12,
+        tournament_selection_n=4,
+        ncycles_per_iteration=4,
+        save_to_file=True,
+        output_directory=str(tmp_path),
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+@pytest.fixture(scope="module")
+def fitted_state(tmp_path_factory):
+    """One tiny fitted SearchState shared by every corruption test."""
+    tmp = tmp_path_factory.mktemp("shield_ckpt")
+    X, y = _problem()
+    options = _options(tmp, save_to_file=False)
+    state, _ = equation_search(
+        X, y, options=options,
+        runtime_options=RuntimeOptions(niterations=1, seed=3, verbosity=0,
+                                       return_state=True),
+    )
+    return state, options
+
+
+# ---------------------------------------------------------------------------
+# corruption modes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("keep_fraction", [0.0, 0.1, 0.5, 0.95])
+def test_truncated_checkpoint_raises_corrupt(tmp_path, fitted_state,
+                                             keep_fraction):
+    state, options = fitted_state
+    p = str(tmp_path / "state.pkl")
+    save_search_state(p, state)
+    faults.truncate_file(p, keep_fraction)
+    with pytest.raises(CheckpointCorruptError):
+        load_search_state(p, options)
+
+
+@pytest.mark.parametrize("offset", [-64, -1024, 64, 200])
+def test_flipped_byte_fails_digest(tmp_path, fitted_state, offset):
+    state, options = fitted_state
+    p = str(tmp_path / "state.pkl")
+    save_search_state(p, state)
+    faults.flip_byte(p, offset)
+    with pytest.raises(CheckpointCorruptError):
+        load_search_state(p, options)
+
+
+def test_stale_format_version_raises_corrupt(tmp_path, fitted_state):
+    state, options = fitted_state
+    p = str(tmp_path / "state.pkl")
+    # A v1-style bare payload with a future format_version.
+    with open(p, "wb") as f:
+        pickle.dump({"format_version": 99, "compat": {}}, f)
+    with pytest.raises(CheckpointCorruptError, match="format_version"):
+        load_search_state(p, options)
+
+
+def test_non_dict_pickle_raises_corrupt(tmp_path, fitted_state):
+    _, options = fitted_state
+    p = str(tmp_path / "state.pkl")
+    with open(p, "wb") as f:
+        pickle.dump([1, 2, 3], f)
+    with pytest.raises(CheckpointCorruptError):
+        load_search_state(p, options)
+
+
+def test_missing_file_is_not_corrupt(tmp_path, fitted_state):
+    _, options = fitted_state
+    with pytest.raises(FileNotFoundError):
+        load_search_state(str(tmp_path / "nope.pkl"), options)
+
+
+def test_clean_roundtrip_preserves_iterations_done(tmp_path, fitted_state):
+    state, options = fitted_state
+    st = dataclasses.replace(state, iterations_done=7)
+    p = str(tmp_path / "state.pkl")
+    save_search_state(p, st)
+    loaded = load_search_state(p, options)
+    assert loaded.iterations_done == 7
+    np.testing.assert_array_equal(
+        np.asarray(st.device_states[0].pops.trees.arity),
+        np.asarray(loaded.device_states[0].pops.trees.arity),
+    )
+
+
+# ---------------------------------------------------------------------------
+# rolling-K + newest-valid fallback
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_keeps_last_k_and_prunes(tmp_path, fitted_state):
+    state, options = fitted_state
+    base = str(tmp_path / "search_state.pkl")
+    ck = RollingCheckpointer(base, keep=3)
+    for n in range(5):
+        st = dataclasses.replace(state, iterations_done=n)
+        ck.save(st)
+    paths = rolled_paths(base, 3)
+    assert [os.path.exists(p) for p in paths] == [True, True, True]
+    assert not os.path.exists(base + ".3"), "pruning failed: kept > K"
+    # newest-first content: iterations_done 4, 3, 2
+    got = [load_search_state(p, options).iterations_done for p in paths]
+    assert got == [4, 3, 2]
+
+
+def test_newest_valid_falls_back_past_corruption(tmp_path, fitted_state):
+    state, options = fitted_state
+    base = str(tmp_path / "search_state.pkl")
+    ck = RollingCheckpointer(base, keep=3)
+    for n in range(3):
+        ck.save(dataclasses.replace(state, iterations_done=n))
+    faults.flip_byte(base)          # newest corrupt
+    faults.truncate_file(base + ".1", 0.2)  # middle corrupt too
+    with pytest.warns(UserWarning, match="corrupt"):
+        loaded, used = load_newest_valid(rolled_paths(base, 3), options)
+    assert used == base + ".2"
+    assert loaded.iterations_done == 0
+
+
+def test_all_corrupt_raises_with_context(tmp_path, fitted_state):
+    state, options = fitted_state
+    base = str(tmp_path / "search_state.pkl")
+    ck = RollingCheckpointer(base, keep=2)
+    ck.save(state)
+    ck.save(state)
+    faults.flip_byte(base)
+    faults.flip_byte(base + ".1")
+    with pytest.warns(UserWarning, match="corrupt"):
+        with pytest.raises(CheckpointCorruptError, match="all 2"):
+            load_newest_valid(rolled_paths(base, 2), options)
+
+
+def test_discover_resume_path_picks_newest_run(tmp_path, fitted_state):
+    state, _ = fitted_state
+    for run, stamp in (("run_a", 1), ("run_b", 2)):
+        d = tmp_path / run
+        d.mkdir()
+        p = str(d / "search_state.pkl")
+        save_search_state(p, state)
+        os.utime(p, (stamp, stamp))
+    cands = discover_resume_path(str(tmp_path))
+    assert cands is not None and "run_b" in cands[0]
+    assert discover_resume_path(str(tmp_path / "missing")) is None
+
+
+# ---------------------------------------------------------------------------
+# multi-host rank shards (unit-level: the container has one process, so
+# the shard/reassemble helpers are driven directly on fake rank sets)
+# ---------------------------------------------------------------------------
+
+
+def test_multihost_rank_reassembly_roundtrip(tmp_path, fitted_state):
+    from symbolicregression_jl_tpu.api.checkpoint import (
+        _ShardRec,
+        _base_payload,
+        _to_numpy_state,
+        _write_envelope,
+    )
+
+    state, options = fitted_state
+    full = _to_numpy_state(state.device_states[0])
+    I = full.pops.cost.shape[0]
+    assert I >= 2, "need >= 2 islands to fake a 2-rank shard split"
+
+    def rank_view(ds, rank, nranks):
+        """Pretend the island axis was sharded over nranks hosts: every
+        [I, ...] population leaf becomes a _ShardRec carrying only this
+        rank's island slice; replicated leaves stay full."""
+        lo, hi = rank * (I // nranks), (rank + 1) * (I // nranks)
+
+        def rec(x):
+            x = np.asarray(x)
+            if x.ndim >= 1 and x.shape[0] == I:
+                idx = (slice(lo, hi),) + tuple(
+                    slice(0, s) for s in x.shape[1:]
+                )
+                return _ShardRec(x.shape, x.dtype, [(idx, x[lo:hi])])
+            return x
+
+        import jax
+
+        return jax.tree.map(rec, ds)
+
+    for rank in range(2):
+        payload = dict(_base_payload(state))
+        payload["multihost"] = {"process_index": rank, "process_count": 2}
+        payload["device_states"] = [rank_view(full, rank, 2)]
+        _write_envelope(str(tmp_path / f"state.pkl.rank{rank}"), payload)
+
+    loaded = load_search_state(str(tmp_path / "state.pkl"), options)
+    np.testing.assert_array_equal(
+        np.asarray(loaded.device_states[0].pops.trees.arity),
+        np.asarray(state.device_states[0].pops.trees.arity),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(loaded.device_states[0].pops.cost),
+        np.asarray(state.device_states[0].pops.cost),
+    )
+
+
+def test_multihost_mixed_generation_raises(tmp_path, fitted_state):
+    # Rank files written at different iterations (one host died later
+    # than the other) must refuse to reassemble into a chimera state.
+    from symbolicregression_jl_tpu.api.checkpoint import (
+        _base_payload,
+        _to_numpy_state,
+        _write_envelope,
+    )
+
+    state, options = fitted_state
+    full = _to_numpy_state(state.device_states[0])
+    for rank, it_done in ((0, 5), (1, 10)):
+        payload = dict(_base_payload(state))
+        payload["iterations_done"] = it_done
+        payload["multihost"] = {"process_index": rank, "process_count": 2}
+        payload["device_states"] = [full]
+        _write_envelope(str(tmp_path / f"state.pkl.rank{rank}"), payload)
+    with pytest.raises(CheckpointCorruptError, match="generations"):
+        load_search_state(str(tmp_path / "state.pkl"), options)
+
+
+def test_rank_glob_ignores_torn_write_leftovers(tmp_path):
+    from symbolicregression_jl_tpu.api.checkpoint import rank_shard_paths
+
+    base = str(tmp_path / "state.pkl")
+    for name in ("state.pkl.rank0", "state.pkl.rank1",
+                 "state.pkl.rank2.bak", "state.pkl.rank10"):
+        (tmp_path / name).write_bytes(b"x")
+    assert rank_shard_paths(base) == [
+        base + ".rank0", base + ".rank1", base + ".rank10"
+    ]
+
+
+def test_multihost_missing_rank_raises(tmp_path, fitted_state):
+    from symbolicregression_jl_tpu.api.checkpoint import (
+        _base_payload,
+        _to_numpy_state,
+        _write_envelope,
+    )
+
+    state, options = fitted_state
+    payload = dict(_base_payload(state))
+    payload["multihost"] = {"process_index": 0, "process_count": 2}
+    payload["device_states"] = [_to_numpy_state(state.device_states[0])]
+    _write_envelope(str(tmp_path / "state.pkl.rank0"), payload)
+    with pytest.raises(CheckpointCorruptError, match="rank"):
+        load_search_state(str(tmp_path / "state.pkl"), options)

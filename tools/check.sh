@@ -21,3 +21,6 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 
 echo "== graftscope: telemetry JSONL schema check (docs/OBSERVABILITY.md) =="
 JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
+
+echo "== graftshield: fault-injection smoke (docs/ROBUSTNESS.md) =="
+JAX_PLATFORMS=cpu python tools/fault_smoke.py

@@ -1,0 +1,189 @@
+"""CI fault-injection smoke: the graftshield recovery paths, end to end
+on CPU (docs/ROBUSTNESS.md; tools/check.sh step 4 and the CI
+``fault-injection-smoke`` job)::
+
+    python tools/fault_smoke.py [out_dir]
+
+Three scenarios, each a full ``equation_search`` driven through the
+deterministic fault harness (shield/faults.py):
+
+1. **preempt**: a real SIGTERM at iteration 2 → graceful stop, emergency
+   checkpoint, then ``resume="auto"`` continues to the 4-iteration
+   target and the final hall of fame is BIT-IDENTICAL to an
+   uninterrupted reference run (the ISSUE-9 acceptance criterion).
+2. **corrupt-checkpoint**: the newest rolling checkpoint gets a flipped
+   byte → resume falls back to the previous valid generation.
+3. **quarantine**: island 0 is NaN-poisoned → the collapsed island is
+   reseeded from the hall of fame and the search finishes finite, with
+   the ``quarantine`` fault event in the telemetry stream.
+
+Exits nonzero on the first failed scenario; telemetry JSONL files are
+left under ``<out_dir>`` as the CI artifact either way.
+"""
+
+import json
+import os
+import sys
+import warnings
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SYMBOLIC_REGRESSION_IS_TESTING", "true")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def _problem():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2.0, 2.0, (128, 2)).astype(np.float32)
+    y = (X[:, 0] * 2.0 + X[:, 1] * X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options(out_base, **kw):
+    from symbolicregression_jl_tpu import Options
+
+    base = dict(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=2,
+        tournament_selection_n=4,
+        optimizer_probability=0.0,
+        output_directory=out_base,
+        telemetry=True,
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _fault_kinds(out_base, run_id):
+    path = os.path.join(out_base, run_id, "telemetry.jsonl")
+    with open(path) as f:
+        return {
+            json.loads(l)["kind"] for l in f if '"event": "fault"' in l
+        }
+
+
+def scenario_preempt(out_base) -> None:
+    import numpy as np
+
+    from symbolicregression_jl_tpu import equation_search
+    from symbolicregression_jl_tpu.api.search import RuntimeOptions
+    from symbolicregression_jl_tpu.shield import faults
+
+    X, y = _problem()
+    ref_state, _ = equation_search(
+        X, y, options=_options(out_base),
+        runtime_options=RuntimeOptions(
+            niterations=4, run_id="smoke-ref", seed=5, verbosity=0),
+        return_state=True)
+
+    faults.install(faults.FaultInjector(
+        faults.FaultPlan(sigterm_at_iteration=2)))
+    try:
+        equation_search(
+            X, y, options=_options(out_base),
+            runtime_options=RuntimeOptions(
+                niterations=4, run_id="smoke-preempt", seed=5, verbosity=0))
+    finally:
+        faults.clear()
+    kinds = _fault_kinds(out_base, "smoke-preempt")
+    assert {"preempt_signal", "emergency_checkpoint"} <= kinds, kinds
+
+    res_state, _ = equation_search(
+        X, y, options=_options(
+            out_base,
+            output_directory=os.path.join(out_base, "smoke-preempt")),
+        resume="auto",
+        runtime_options=RuntimeOptions(
+            niterations=4, run_id="smoke-resume", seed=31, verbosity=0),
+        return_state=True)
+    a, c = ref_state.device_states[0], res_state.device_states[0]
+    for f in ("arity", "op", "feat", "const", "length"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.hof.trees, f)),
+            np.asarray(getattr(c.hof.trees, f)))
+    np.testing.assert_array_equal(np.asarray(a.hof.cost),
+                                  np.asarray(c.hof.cost))
+    np.testing.assert_array_equal(np.asarray(a.pops.cost),
+                                  np.asarray(c.pops.cost))
+
+
+def scenario_corrupt_checkpoint(out_base) -> None:
+    from symbolicregression_jl_tpu import equation_search
+    from symbolicregression_jl_tpu.api.search import RuntimeOptions
+    from symbolicregression_jl_tpu.shield import faults
+
+    X, y = _problem()
+    equation_search(
+        X, y, options=_options(out_base),
+        runtime_options=RuntimeOptions(
+            niterations=3, run_id="smoke-corrupt", seed=5, verbosity=0,
+            checkpoint_every_n=1))
+    run_dir = os.path.join(out_base, "smoke-corrupt")
+    faults.flip_byte(os.path.join(run_dir, "search_state.pkl"))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        state, _ = equation_search(
+            X, y, options=_options(out_base, output_directory=run_dir),
+            resume="auto",
+            runtime_options=RuntimeOptions(
+                niterations=4, run_id="smoke-corrupt-resume", seed=5,
+                verbosity=0),
+            return_state=True)
+    assert any("corrupt" in str(w.message) for w in caught), (
+        "no corruption warning surfaced")
+    assert state.iterations_done == 4
+
+
+def scenario_quarantine(out_base) -> None:
+    import numpy as np
+
+    from symbolicregression_jl_tpu import equation_search
+    from symbolicregression_jl_tpu.api.search import RuntimeOptions
+    from symbolicregression_jl_tpu.shield import faults
+
+    X, y = _problem()
+    faults.install(faults.FaultInjector(
+        faults.FaultPlan(nan_poison_island=(0, 1))))
+    try:
+        state, hof = equation_search(
+            X, y, options=_options(out_base),
+            runtime_options=RuntimeOptions(
+                niterations=3, run_id="smoke-quarantine", seed=5,
+                verbosity=0),
+            return_state=True)
+    finally:
+        faults.clear()
+    kinds = _fault_kinds(out_base, "smoke-quarantine")
+    assert "quarantine" in kinds, kinds
+    loss = np.asarray(state.device_states[0].pops.loss)
+    assert np.isfinite(loss[0]).any(), "quarantined island still dead"
+    assert len(hof.entries) > 0
+
+
+def main() -> int:
+    out_base = sys.argv[1] if len(sys.argv) > 1 else "/tmp/sr_fault_smoke"
+    scenarios = [
+        ("preempt+resume-bit-identical", scenario_preempt),
+        ("corrupt-checkpoint-fallback", scenario_corrupt_checkpoint),
+        ("nan-storm-quarantine", scenario_quarantine),
+    ]
+    for name, fn in scenarios:
+        try:
+            fn(out_base)
+        except Exception as e:  # noqa: BLE001 - report and fail the job
+            print(f"FAIL [{name}]: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"OK   [{name}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
