@@ -52,6 +52,9 @@ __all__ = [
     # star-imports and IDE completion see them:
     "equation_search",
     "warmup",
+    # lazily exposed via __getattr__ (serve) — graftserve service layer
+    "SearchServer",
+    "ServerSaturated",
 ]
 
 
@@ -77,6 +80,10 @@ def __getattr__(name):
         from .api import regressor
 
         return getattr(regressor, name)
+    if name in ("SearchServer", "ServerSaturated"):
+        from . import serve
+
+        return getattr(serve, name)
     if name in ("ExpressionSpec", "ParametricExpressionSpec"):
         from . import models
 

@@ -33,6 +33,7 @@ import glob
 import hashlib
 import os
 import pickle
+import types
 import warnings
 from typing import TYPE_CHECKING, Any, List, Optional
 
@@ -48,6 +49,7 @@ __all__ = [
     "save_search_state",
     "load_search_state",
     "options_compat_header",
+    "options_fingerprint",
     "rank_shard_paths",
 ]
 
@@ -105,6 +107,134 @@ def options_compat_header(options: "Options") -> dict:
     header["expression_spec"] = spec_desc
     header["template_combiner_fp"] = fp
     return header
+
+
+# Options fields that only shape HOST-side supervision/IO — never the
+# device programs or search numerics — so two configs differing only
+# here may share one compiled engine (serve/cache.py).
+_HOST_ONLY_OPTION_FIELDS = frozenset({
+    "output_directory", "save_to_file", "use_recorder", "recorder_file",
+    "recorder_verbosity", "verbosity", "print_precision", "progress",
+    "telemetry", "telemetry_file", "telemetry_interval",
+    "interactive_quit", "checkpoint_keep", "max_retries", "retry_backoff",
+    "iteration_deadline", "compile_budget", "shield",
+    "early_stop_condition", "timeout_in_seconds", "max_evals",
+    # the seed feeds the host-made PRNG key at run time; the compiled
+    # programs are seed-agnostic (the key is a traced input)
+    "seed",
+})
+
+
+class _Unfingerprintable(Exception):
+    """A value with no process-stable canonical form (e.g. a C callable
+    or an arbitrary object) — the config is uncacheable, not an error."""
+
+
+def _global_name_reads(code) -> set:
+    """All names a code object (and its nested genexprs/lambdas/
+    comprehensions, which carry their own code objects in co_consts)
+    may resolve through globals — the guard in _value_fp must see reads
+    from the inner frames too, or a `W*(p-t)` inside a genexpr escapes
+    it."""
+    names = set(code.co_names)
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            names |= _global_name_reads(const)
+    return names
+
+
+def _value_fp(v) -> str:
+    """Stable stringification of one Options field value."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return repr(v)
+    if isinstance(v, np.ndarray):
+        return f"nd:{v.dtype}:{v.shape}:{hashlib.sha1(v.tobytes()).hexdigest()[:16]}"
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(_value_fp(x) for x in v) + "]"
+    if isinstance(v, (set, frozenset)):
+        return "{" + ",".join(sorted(_value_fp(x) for x in v)) + "}"
+    if isinstance(v, dict):
+        items = sorted(
+            ((_value_fp(k), _value_fp(x)) for k, x in v.items()))
+        return "{" + ",".join(f"{k}:{x}" for k, x in items) + "}"
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return type(v).__name__ + _value_fp(dataclasses.asdict(v))
+    if callable(v):
+        code = getattr(v, "__code__", None)
+        if code is None:
+            raise _Unfingerprintable(repr(v))
+        # a non-module global read (module-level constant, helper fn)
+        # has no process-stable canonical form: it can be rebound
+        # between runs without changing the code object, so two
+        # behaviorally different callables would collide. Module
+        # references (jnp, np, math) are fine — the code digest pins
+        # how the names are used.
+        g = getattr(v, "__globals__", None)
+        if g is not None:
+            for name in _global_name_reads(code):
+                if name in g and not isinstance(
+                        g[name], types.ModuleType):
+                    raise _Unfingerprintable(
+                        f"{getattr(v, '__qualname__', v)!r} reads "
+                        f"global {name!r}")
+        # closure cells + defaults (positional AND keyword-only):
+        # huber_loss(delta=1.0) and huber_loss(delta=2.0) share co_code
+        # but are different losses
+        extras = ""
+        cells = getattr(v, "__closure__", None)
+        if cells:
+            extras += ":c" + _value_fp(
+                tuple(c.cell_contents for c in cells))
+        if getattr(v, "__defaults__", None):
+            extras += ":d" + _value_fp(v.__defaults__)
+        if getattr(v, "__kwdefaults__", None):
+            extras += ":k" + _value_fp(v.__kwdefaults__)
+        # a bound method's behavior depends on its receiver's state;
+        # an unfingerprintable __self__ makes the config uncacheable
+        if getattr(v, "__self__", None) is not None:
+            extras += ":s" + _value_fp(v.__self__)
+        return (f"fn:{getattr(v, '__qualname__', '?')}:"
+                f"{_code_digest(code)}{extras}")
+    raise _Unfingerprintable(repr(v))
+
+
+def options_fingerprint(options: "Options") -> Optional[str]:
+    """Canonical digest of everything in an ``Options`` that can affect
+    the compiled search programs or the search numerics.
+
+    Two Options instances with equal fingerprints run the identical
+    device search; host-only supervision/IO fields
+    (:data:`_HOST_ONLY_OPTION_FIELDS`) are excluded, so e.g. a different
+    output directory or telemetry cadence still shares a compiled
+    engine. Returns None when any load-bearing field has no
+    process-stable canonical form (a C callable, an arbitrary object) —
+    callers must then treat the config as uncacheable rather than risk
+    a silent hyperparameter collision.
+    """
+    parts = []
+    try:
+        for name in sorted(vars(options)):
+            if name in _HOST_ONLY_OPTION_FIELDS:
+                continue
+            value = getattr(options, name)
+            if name == "operators":
+                # fingerprint by (name, arity, fn-code): two same-named
+                # custom ops with different bodies must not collide
+                value = {
+                    d: [(op.name, op.arity, getattr(op, "fn", None))
+                        for op in ops]
+                    for d, ops in value.ops.items()
+                }
+            elif name == "expression_spec":
+                # the compat header already canonicalizes specs (incl.
+                # the template-combiner code fingerprint)
+                header = options_compat_header(options)
+                value = (header["expression_spec"],
+                         header["template_combiner_fp"])
+            parts.append(f"{name}={_value_fp(value)}")
+    except _Unfingerprintable:
+        return None
+    return hashlib.sha256("\n".join(parts).encode()).hexdigest()
 
 
 def _code_digest(code) -> str:

@@ -15,6 +15,7 @@ import dataclasses
 import math
 import os
 import re
+import sys
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -86,6 +87,23 @@ class RuntimeOptions:
     # Full-state checkpoint cadence (iterations) when save_to_file is on;
     # the final/stopping iteration always checkpoints.
     checkpoint_every_n: int = 5
+    # External stop hook (the graftserve layer's cancellation/deadline
+    # wire, docs/SERVING.md): polled once per iteration AT THE BOUNDARY
+    # — like the preemption guard, and unlike user_quit/timeout, it is
+    # deliberately NOT polled between evolve chunks, so a stop never
+    # truncates an iteration mid-flight and the checkpointed state stays
+    # on the bit-identical resume="auto" trajectory. Return a
+    # stop_reason string (e.g. "cancelled", "deadline") to stop; None
+    # to continue.
+    stop_hook: Optional[Callable[[], Optional[str]]] = None
+    # Compiled-engine cache (serve/cache.py ExecutableCache): when set,
+    # engine construction first consults the cache so repeat requests
+    # with an equivalent canonical Options + dataset shape share one
+    # Engine instance — and therefore one set of compiled XLA
+    # executables (the jit caches live on the engine's callables).
+    # get_engine returning None falls back to a fresh Engine
+    # (uncacheable config: templates, un-fingerprintable callables).
+    engine_cache: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -693,10 +711,27 @@ def equation_search(
                     f"Template combiner consumes {template.n_variables} "
                     f"variables but the dataset has {ds.nfeatures} features"
                 )
-        engine = Engine(options, ds.nfeatures, dtype=_np_dtype(options.eval_dtype),
-                        n_params=n_params, n_classes=n_classes,
-                        template=template, n_data_shards=ropt.n_data_shards,
-                        n_island_shards=n_island_shards, mesh=mesh)
+        # graftserve executable cache: an equivalent canonical config
+        # reuses a prior request's Engine (and its compiled programs)
+        # instead of re-tracing ~minutes of XLA per request. A None
+        # return (no cache, or uncacheable config) builds fresh.
+        engine = None
+        if ropt.engine_cache is not None:
+            engine = ropt.engine_cache.get_engine(
+                options, nfeatures=ds.nfeatures,
+                dtype=_np_dtype(options.eval_dtype),
+                n_params=n_params, n_classes=n_classes, template=template,
+                n_data_shards=ropt.n_data_shards,
+                n_island_shards=n_island_shards, mesh=mesh,
+                rows=int(ds.X.shape[0]),
+            )
+        if engine is None:
+            engine = Engine(options, ds.nfeatures,
+                            dtype=_np_dtype(options.eval_dtype),
+                            n_params=n_params, n_classes=n_classes,
+                            template=template,
+                            n_data_shards=ropt.n_data_shards,
+                            n_island_shards=n_island_shards, mesh=mesh)
         data = shard_device_data(ds.data, mesh)
         key, k_init = jax.random.split(key)
         if saved_state is not None and j < len(saved_state.device_states):
@@ -917,9 +952,18 @@ def equation_search(
     from ..utils.stdin_quit import StdinQuitWatcher
 
     try:
-        watcher = StdinQuitWatcher(
-            ropt.input_stream, force=ropt.input_stream is not None
-        )
+        # Engage the stdin watcher only for an injected test stream or a
+        # genuinely interactive session (Options(interactive_quit=True)
+        # AND a real TTY). Headless/batch/server runs get the disabled
+        # form: no background thread reading stdin per request, no
+        # termios fiddling (the multi-tenant server would otherwise leak
+        # one watcher thread per request).
+        if ropt.input_stream is not None:
+            watcher = StdinQuitWatcher(ropt.input_stream, force=True)
+        elif options.interactive_quit and _stdin_is_tty():
+            watcher = StdinQuitWatcher()
+        else:
+            watcher = StdinQuitWatcher.disabled()
 
         def _budget_stop(pending_evals=None) -> Optional[str]:
             """``pending_evals``: optional thunk for not-yet-landed evals of a
@@ -1112,6 +1156,17 @@ def equation_search(
                     "preempt_signal", iteration=it,
                     signal=guard.signal_name,
                 )
+            # External stop hook (serve cancellation/deadline): boundary-
+            # only, same contract as the preemption guard above — the
+            # state checkpointed after this stop is one an uninterrupted
+            # run also reaches, keeping resume="auto" bit-identical.
+            if stop_reason is None and ropt.stop_hook is not None:
+                hook_reason = ropt.stop_hook()
+                if hook_reason:
+                    stop_reason = str(hook_reason)
+                    hub.fault(
+                        "external_stop", iteration=it, reason=stop_reason,
+                    )
 
             # Host-side bookkeeping once per iteration (not per cycle).
             total_evals = num_evals0 + sum(
@@ -1308,6 +1363,13 @@ def warmup(
         X, y, options=options, niterations=niterations,
         verbosity=0, progress=False, seed=seed, dtype=dtype,
     )
+
+
+def _stdin_is_tty() -> bool:
+    try:
+        return sys.stdin is not None and sys.stdin.isatty()
+    except (AttributeError, ValueError, OSError):
+        return False
 
 
 def _is_guess_pair(g) -> bool:

@@ -351,6 +351,13 @@ class Options:
         telemetry: bool = False,
         telemetry_file: str = "telemetry.jsonl",
         telemetry_interval: int = 1,
+        # Interactive 'q'-to-quit stdin watcher: engaged only when this
+        # is True AND sys.stdin is a real TTY (or an explicit
+        # RuntimeOptions.input_stream is injected). Headless/server
+        # deployments (graftserve) set False so a long-lived process
+        # never spawns a stdin-reading thread or flips terminal modes
+        # per request (docs/SERVING.md).
+        interactive_quit: bool = True,
         # graftshield fault tolerance (shield/ package, docs/ROBUSTNESS.md):
         # `shield` arms the whole supervision layer in equation_search —
         # SIGTERM/SIGINT → graceful stop + emergency checkpoint at the
@@ -565,6 +572,7 @@ class Options:
         self.telemetry = bool(telemetry)
         self.telemetry_file = str(telemetry_file)
         self.telemetry_interval = int(telemetry_interval)
+        self.interactive_quit = bool(interactive_quit)
         self.shield = bool(shield)
         self.iteration_deadline = (
             None if iteration_deadline is None else float(iteration_deadline)

@@ -1,0 +1,190 @@
+"""Compiled-executable cache: share engines (and their XLA programs)
+across requests.
+
+A cold ``equation_search`` pays the full trace+compile cost of the
+evolve/epilogue programs (up to ~160 s at the device-scale config even
+after the round-5 work). The per-engine jit caches live on the
+``Engine`` instance's jitted callables, so a fresh Engine per request —
+what ``equation_search`` builds by default — re-traces everything even
+when jax's persistent compilation cache (api/search.py
+``_enable_default_compile_cache``) absorbs the backend compile.
+
+:class:`ExecutableCache` closes that gap for the serve layer: requests
+whose **canonical Options fingerprint** (api/checkpoint.py
+``options_fingerprint`` — every field that can affect the device
+programs or numerics, host-only IO/supervision fields excluded) and
+structural geometry (features, shards, mesh, dtype, params) match reuse
+one Engine instance, and with it every compiled executable. Shape
+buckets (serve/admission.py) label the hit/miss counters graftscope
+reports; within one shared engine, each distinct row count still
+compiles once and is then warm for every later request at that shape.
+
+Uncacheable configs — template expressions (host callables inside the
+engine), custom C callables the fingerprint cannot canonicalize —
+return None and the caller builds a fresh Engine; correctness is never
+traded for a cache hit.
+
+Concurrency notes: jax jit dispatch/compilation is thread-safe, so two
+worker threads sharing an engine at worst duplicate one compile.
+``Engine.degrade_eval_tile_rows`` (the OOM step-down) mutates the
+shared engine — a degrade triggered by one tenant lowers the launch
+geometry for all of them, which is the intended whole-device behavior
+under memory pressure (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..api.checkpoint import options_fingerprint
+
+__all__ = ["ExecutableCache"]
+
+
+class ExecutableCache:
+    """Process-wide Engine cache keyed by canonical config + geometry."""
+
+    def __init__(
+        self,
+        on_event: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+        max_entries: int = 16,
+    ) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple, Any] = {}
+        self._on_event = on_event
+        self.max_entries = int(max_entries)
+        self.hits = 0
+        self.misses = 0
+        self.uncacheable = 0
+        self.by_bucket: Dict[Tuple[int, int, int],
+                             Dict[str, int]] = {}
+
+    # ------------------------------------------------------------------
+    def _note(self, kind: str, bucket, detail: Dict[str, Any]) -> None:
+        if bucket is not None:
+            with self._lock:
+                d = self.by_bucket.setdefault(
+                    tuple(bucket), {"hits": 0, "misses": 0})
+                if kind == "cache_hit":
+                    d["hits"] += 1
+                elif kind == "cache_miss":
+                    d["misses"] += 1
+        if self._on_event is not None:
+            try:
+                self._on_event(kind, detail)
+            except Exception:  # pragma: no cover - audit is best-effort
+                pass
+
+    @staticmethod
+    def _mesh_key(mesh) -> Tuple:
+        try:
+            return (
+                tuple(d.id for d in np.asarray(mesh.devices).flat),
+                tuple(mesh.axis_names),
+                tuple(np.asarray(mesh.devices).shape),
+            )
+        except Exception:
+            return (repr(mesh),)
+
+    # ------------------------------------------------------------------
+    def get_engine(
+        self,
+        options,
+        *,
+        nfeatures: int,
+        dtype,
+        n_params: int,
+        n_classes: int,
+        template,
+        n_data_shards: int,
+        n_island_shards: int,
+        mesh,
+        rows: int,
+        bucket: Optional[Tuple[int, int, int]] = None,
+    ):
+        """An Engine for this config — shared when possible, else fresh
+        (and cached), else None (uncacheable; caller builds its own)."""
+        if bucket is None:
+            from .admission import shape_bucket
+
+            bucket = shape_bucket(rows, nfeatures)
+        if template is not None:
+            # template structures hold host callables whose identity the
+            # fingerprint cannot guarantee across requests
+            self.uncacheable += 1
+            self._note("cache_uncacheable", None,
+                       {"reason": "template", "bucket": list(bucket)})
+            return None
+        fp = options_fingerprint(options)
+        if fp is None:
+            self.uncacheable += 1
+            self._note("cache_uncacheable", None,
+                       {"reason": "unfingerprintable",
+                        "bucket": list(bucket)})
+            return None
+        key = (
+            fp, int(nfeatures), str(np.dtype(dtype)), int(n_params),
+            int(n_classes), int(n_data_shards), int(n_island_shards),
+            self._mesh_key(mesh),
+        )
+        with self._lock:
+            engine = self._entries.get(key)
+            if engine is not None:
+                # LRU refresh: re-insert at the end of the (insertion-
+                # ordered) dict so the hottest engine is never the
+                # first evicted when the cache fills
+                self._entries.pop(key)
+                self._entries[key] = engine
+                self.hits += 1
+        if engine is not None:
+            self._note("cache_hit", bucket,
+                       {"bucket": list(bucket), "rows": int(rows)})
+            return engine
+        from ..evolve.engine import Engine
+
+        # build OUTSIDE the lock: a slow construction for one config
+        # must not serialize other workers' lookups. Losing the insert
+        # race costs at most one duplicated build.
+        engine = Engine(
+            options, nfeatures, dtype=dtype, n_params=n_params,
+            n_classes=n_classes, template=template,
+            n_data_shards=n_data_shards,
+            n_island_shards=n_island_shards, mesh=mesh,
+        )
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                engine = existing  # another worker won the race
+            else:
+                if len(self._entries) >= self.max_entries:
+                    # drop the least-recently-used entry (hits
+                    # re-insert at the end) — a bounded cache must not
+                    # pin every config's programs forever
+                    oldest = next(iter(self._entries))
+                    self._entries.pop(oldest, None)
+                self._entries[key] = engine
+            self.misses += 1
+        self._note("cache_miss", bucket,
+                   {"bucket": list(bucket), "rows": int(rows)})
+        return engine
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        total = self.hits + self.misses
+        return {
+            "entries": len(self._entries),
+            "hits": self.hits,
+            "misses": self.misses,
+            "uncacheable": self.uncacheable,
+            "hit_rate": (self.hits / total) if total else None,
+            "by_bucket": {
+                str(list(b)): dict(d) for b, d in self.by_bucket.items()
+            },
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()

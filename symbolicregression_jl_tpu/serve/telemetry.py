@@ -1,0 +1,72 @@
+"""Server-side graftscope emitter.
+
+Each request's *search* writes its own graftscope.v1 stream (the
+Telemetry hub, telemetry/hub.py) under the request's run directory; the
+server itself writes one long-lived stream of ``serve`` and ``fault``
+events — the fleet-level audit trail: admissions, rejections, journal
+replay, cache hits, overload shedding, shutdowns. Both streams are the
+same schema (telemetry/schema.py), so ``telemetry report`` and
+``telemetry validate`` work on either, and the report's per-request
+view groups serve events by request_id (docs/SERVING.md).
+
+Unlike the per-search hub, this file is opened in append mode and
+persists across server restarts — a restarted server's ``replay``
+events land in the same stream as the original acceptances, which is
+what makes the recovery auditable end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..telemetry.schema import SCHEMA_VERSION
+
+__all__ = ["ServeLog"]
+
+
+class ServeLog:
+    """Append-only graftscope.v1 emitter for serve/fault events."""
+
+    def __init__(self, path: Optional[str]) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        self.counts: Dict[str, int] = {}
+        if path is not None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def _emit(self, obj: Dict[str, Any]) -> None:
+        obj = {"schema": SCHEMA_VERSION, "t": time.time(), **obj}
+        if self.path is None:
+            return
+        try:
+            with self._lock, open(self.path, "a") as f:
+                f.write(json.dumps(obj) + "\n")
+        except OSError:  # auditing must never break serving
+            pass
+
+    # ------------------------------------------------------------------
+    def serve(self, kind: str, request_id: str, **detail) -> None:
+        """One request-lifecycle event (schema event type ``serve``)."""
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+        self._emit({
+            "event": "serve",
+            "kind": str(kind),
+            "request_id": str(request_id),
+            "detail": {k: v for k, v in detail.items() if v is not None},
+        })
+
+    def fault(self, kind: str, *, iteration: int = 0, **detail) -> None:
+        """A shield-style fault/recovery audit record — same shape the
+        search hub emits, so OverloadLadder and the fault injectors can
+        target either sink."""
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+        self._emit({
+            "event": "fault",
+            "kind": str(kind),
+            "iteration": int(iteration),
+            "detail": {k: v for k, v in detail.items() if v is not None},
+        })

@@ -261,7 +261,13 @@ class Telemetry:
         return event
 
     def _emit(self, obj: Dict[str, Any]) -> None:
-        obj = {"schema": SCHEMA_VERSION, "t": time.time(), **obj}
+        # run_id on EVERY event (not just run_start) so concatenated or
+        # multi-tenant streams stay attributable: `telemetry report`
+        # groups records by run_id/request_id (docs/SERVING.md).
+        obj = {
+            "schema": SCHEMA_VERSION, "t": time.time(),
+            "run_id": self.run_id, **obj,
+        }
         with open(self.path, "a") as f:
             f.write(json.dumps(obj) + "\n")
 

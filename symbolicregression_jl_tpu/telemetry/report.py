@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional
 
 from .schema import SCHEMA_VERSION, load_events, validate_lines
 
-__all__ = ["summarize", "format_report", "main"]
+__all__ = ["summarize", "summarize_requests", "format_report", "main"]
 
 
 def _mean(xs: List[float]) -> Optional[float]:
@@ -32,12 +32,99 @@ def _rate(num: int, den: int) -> Optional[float]:
     return num / den if den else None
 
 
+_SERVE_LIFECYCLE = ("accept", "start", "interrupted", "done", "cancel",
+                    "reject", "failed")
+
+
+def _request_key(e: dict) -> Optional[str]:
+    """The grouping key of one event for the per-request view: an
+    explicit request_id (serve events; fault events emitted by the serve
+    layer carry it in detail), else the emitting search's run_id."""
+    rid = e.get("request_id")
+    if not rid and isinstance(e.get("detail"), dict):
+        rid = e["detail"].get("request_id")
+    return (rid or e.get("run_id")) or None
+
+
+def summarize_requests(events: List[dict]) -> Dict[str, Any]:
+    """Group graftscope.v1 records by run_id/request_id — the
+    per-request view of a multi-tenant (graftserve) or concatenated
+    stream. Events without either id (pre-run_id single-search files)
+    are ignored."""
+    groups: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        key = _request_key(e)
+        if key is None:
+            continue
+        g = groups.setdefault(key, {
+            "events": 0, "iterations": 0, "num_evals": None,
+            "faults": {}, "serve": {}, "state": None,
+            "first_t": None, "last_t": None, "stop_reason": None,
+        })
+        g["events"] += 1
+        t = e.get("t")
+        if isinstance(t, (int, float)):
+            g["first_t"] = t if g["first_t"] is None else min(g["first_t"], t)
+            g["last_t"] = t if g["last_t"] is None else max(g["last_t"], t)
+        kind = e.get("kind")
+        if e["event"] == "iteration":
+            g["iterations"] = max(g["iterations"], int(e["iteration"]))
+            g["num_evals"] = e.get("num_evals")
+        elif e["event"] == "run_end":
+            g["stop_reason"] = e.get("stop_reason")
+            g["iterations"] = max(g["iterations"],
+                                  int(e.get("iterations", 0)))
+        elif e["event"] == "fault":
+            g["faults"][kind] = g["faults"].get(kind, 0) + 1
+        elif e["event"] == "serve":
+            g["serve"][kind] = g["serve"].get(kind, 0) + 1
+            if kind in _SERVE_LIFECYCLE:
+                g["state"] = kind
+    for g in groups.values():
+        if g["first_t"] is not None and g["last_t"] is not None:
+            g["span_s"] = g["last_t"] - g["first_t"]
+    return groups
+
+
+def _summarize_serve(serve: List[dict]) -> Dict[str, Any]:
+    """Fleet-level aggregates of graftserve events: lifecycle counts,
+    executable-cache hit rate (overall and per shape bucket), admission
+    rejections."""
+    kinds: Dict[str, int] = {}
+    for e in serve:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    hits = kinds.get("cache_hit", 0)
+    misses = kinds.get("cache_miss", 0)
+    by_bucket: Dict[str, Dict[str, int]] = {}
+    for e in serve:
+        if e["kind"] not in ("cache_hit", "cache_miss"):
+            continue
+        b = str(e.get("detail", {}).get("bucket"))
+        d = by_bucket.setdefault(b, {"hits": 0, "misses": 0})
+        d["hits" if e["kind"] == "cache_hit" else "misses"] += 1
+    for d in by_bucket.values():
+        d["hit_rate"] = _rate(d["hits"], d["hits"] + d["misses"])
+    return {
+        "events": len(serve),
+        "by_kind": kinds,
+        "accepted": kinds.get("accept", 0),
+        "rejected": kinds.get("reject", 0),
+        "cache": {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": _rate(hits, hits + misses),
+            "by_bucket": by_bucket,
+        },
+    }
+
+
 def summarize(events: List[dict]) -> Dict[str, Any]:
     """Machine-readable summary of a validated event list."""
     run_start = next((e for e in events if e["event"] == "run_start"), None)
     run_end = next((e for e in events if e["event"] == "run_end"), None)
     iters = [e for e in events if e["event"] == "iteration"]
     faults = [e for e in events if e["event"] == "fault"]
+    serve = [e for e in events if e["event"] == "serve"]
 
     summary: Dict[str, Any] = {"schema": SCHEMA_VERSION}
     if run_start is not None:
@@ -141,6 +228,16 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
             "timeline": [[e["iteration"], e["kind"]] for e in faults[:50]],
         }
 
+    # graftserve per-request view (docs/SERVING.md): the serve event
+    # stream always gets one; a plain search stream gets one only when
+    # it actually interleaves multiple run_ids.
+    request_groups = summarize_requests(events)
+    if serve:
+        summary["serve"] = _summarize_serve(serve)
+        summary["requests"] = request_groups
+    elif len(request_groups) > 1:
+        summary["requests"] = request_groups
+
     if run_end is not None:
         summary["end"] = {
             k: run_end.get(k)
@@ -240,6 +337,46 @@ def format_report(summary: Dict[str, Any]) -> str:
         )
         for it_n, kind in fl.get("timeline", [])[:12]:
             lines.append(f"  iter {it_n}: {kind}")
+    sv = summary.get("serve")
+    if sv:
+        cache = sv["cache"]
+        lines.append(
+            f"serve: {sv['accepted']} accepted, {sv['rejected']} rejected"
+            f"  |  executable cache {cache['hits']} hit / "
+            f"{cache['misses']} miss ({_fmt_pct(cache['hit_rate'])})"
+        )
+        other = {k: v for k, v in sorted(sv["by_kind"].items())
+                 if k not in ("accept", "reject")}
+        if other:
+            lines.append(
+                "  events: " + ", ".join(f"{k}={v}" for k, v in other.items())
+            )
+    reqs = summary.get("requests")
+    if reqs:
+        lines.append(f"requests: {len(reqs)} (grouped by request_id/run_id)")
+        for rid in sorted(reqs):
+            g = reqs[rid]
+            bits = []
+            if g.get("state"):
+                bits.append(g["state"])
+            if g.get("stop_reason"):
+                bits.append(f"stop={g['stop_reason']}")
+            if g.get("iterations"):
+                bits.append(f"iters={g['iterations']}")
+            if g.get("num_evals") is not None:
+                bits.append(f"evals={_fmt_num(g['num_evals'])}")
+            if g.get("faults"):
+                bits.append(
+                    "faults["
+                    + ",".join(f"{k}={v}"
+                               for k, v in sorted(g["faults"].items()))
+                    + "]"
+                )
+            if g.get("serve", {}).get("cache_hit"):
+                bits.append("cache-hit")
+            if g.get("span_s") is not None:
+                bits.append(f"{g['span_s']:.1f}s")
+            lines.append(f"  {rid}: " + (", ".join(bits) or "no activity"))
     end = summary.get("end")
     if end:
         lines.append(

@@ -66,6 +66,19 @@ EVENT_SPECS: Dict[str, Dict[str, Any]] = {
         "iteration": int,
         "detail": dict,
     },
+    # graftserve request-lifecycle audit records (docs/SERVING.md):
+    # kind is one of accept / reject / start / done / cancel / failed /
+    # interrupted / replay / cache_hit / cache_miss / injected /
+    # shutdown;
+    # request_id ties the event to one journaled request (report's
+    # per-request view groups on it, falling back to run_id for plain
+    # search events); detail carries kind-specific fields (shape bucket,
+    # queue depth, retry-after, result fingerprint).
+    "serve": {
+        "kind": str,
+        "request_id": str,
+        "detail": dict,
+    },
 }
 
 # required keys inside each element of iteration.outputs; nullable

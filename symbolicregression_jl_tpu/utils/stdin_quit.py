@@ -50,6 +50,22 @@ class StdinQuitWatcher:
     streams in tests).
     """
 
+    @classmethod
+    def disabled(cls) -> "StdinQuitWatcher":
+        """A watcher that never engages and never touches ``sys.stdin``
+        — the headless/server form (``Options(interactive_quit=False)``
+        or a non-TTY stdin). No thread, no termios, ``check()`` is
+        always False; a long-lived multi-tenant server must not spawn a
+        stdin-consuming thread (or flip terminal modes) per request."""
+        w = cls.__new__(cls)
+        w.stream = None
+        w.quit = False
+        w._stopped = True
+        w._thread = None
+        w._saved_termios = None
+        w.active = False
+        return w
+
     def __init__(self, stream: Optional[TextIO] = None, force: bool = False):
         self.stream = stream if stream is not None else sys.stdin
         self.quit = False

@@ -1,0 +1,98 @@
+"""Multi-tenancy at the API layer: two ``equation_search`` calls running
+concurrently in threads of ONE process (distinct seeds/options) must not
+interfere through any shared global — each result must be bit-identical
+to its own solo-run reference. This is the contract the graftserve
+worker pool stands on (docs/SERVING.md); the refcounted PreemptionGuard
+(shield/signals.py) and the per-request StdinQuitWatcher guard are what
+make it hold."""
+
+import threading
+
+import numpy as np
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.api.search import RuntimeOptions
+
+
+def _problem(seed):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2.0, 2.0, (128, 2)).astype(np.float32)
+    y = (X[:, 0] * 2.0 + X[:, 1] * X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options(**kw):
+    base = dict(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=2,
+        tournament_selection_n=4,
+        optimizer_probability=0.0,
+        save_to_file=False,
+        interactive_quit=False,
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _hof_arrays(state):
+    ds = state.device_states[0]
+    return {
+        **{f: np.asarray(getattr(ds.hof.trees, f))
+           for f in ("arity", "op", "feat", "const", "length")},
+        "cost": np.asarray(ds.hof.cost),
+        "loss": np.asarray(ds.hof.loss),
+    }
+
+
+def _run(spec):
+    X, y = _problem(spec["data_seed"])
+    state, _ = equation_search(
+        X, y, options=spec["options"](),
+        runtime_options=RuntimeOptions(
+            niterations=spec["niterations"], seed=spec["seed"],
+            verbosity=0, return_state=True),
+    )
+    return _hof_arrays(state)
+
+
+def test_concurrent_searches_match_solo_references():
+    # distinct seeds AND distinct options (different annealing/parsimony
+    # host params; same tensor shapes so the test shares compiles)
+    specs = {
+        "a": dict(data_seed=0, seed=11, niterations=3,
+                  options=lambda: _options(parsimony=0.0)),
+        "b": dict(data_seed=1, seed=22, niterations=4,
+                  options=lambda: _options(parsimony=0.01,
+                                           annealing=False)),
+    }
+    solo = {k: _run(s) for k, s in specs.items()}
+
+    results, errors = {}, {}
+
+    def worker(name, spec):
+        try:
+            results[name] = _run(spec)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors[name] = e
+
+    threads = [
+        threading.Thread(target=worker, args=(k, s), name=f"search-{k}")
+        for k, s in specs.items()
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    assert not errors, errors
+    assert set(results) == set(specs)
+    for name in specs:
+        for field, ref in solo[name].items():
+            np.testing.assert_array_equal(
+                results[name][field], ref,
+                err_msg=f"search {name!r} field {field!r} diverged when "
+                        f"run concurrently with another tenant",
+            )

@@ -1,0 +1,362 @@
+"""graftserve host-side units: journal durability/corruption semantics,
+shape-bucketed admission + overload ladder, canonical options
+fingerprint, result encoding (docs/SERVING.md)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.api.checkpoint import options_fingerprint
+from symbolicregression_jl_tpu.serve.admission import (
+    AdmissionController,
+    ServerSaturated,
+    shape_bucket,
+)
+from symbolicregression_jl_tpu.serve.journal import (
+    JOURNAL_SCHEMA,
+    RequestJournal,
+    decode_array,
+    encode_array,
+)
+from symbolicregression_jl_tpu.shield.degrade import OverloadLadder
+from symbolicregression_jl_tpu.shield.faults import flip_byte
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_and_array_bit_exactness(tmp_path):
+    j = RequestJournal(str(tmp_path / "j.jsonl"))
+    X = np.random.default_rng(0).normal(size=(7, 3)).astype(np.float32)
+    j.append("submit", "r1", {"X": encode_array(X), "n": 4})
+    j.append("start", "r1", {})
+    j.append("done", "r1", {"result": {"fingerprint": "abc"}})
+    records, corrupt = j.replay()
+    assert not corrupt
+    assert [r["event"] for r in records] == ["submit", "start", "done"]
+    assert all(r["schema"] == JOURNAL_SCHEMA for r in records)
+    assert [r["seq"] for r in records] == [1, 2, 3]
+    back = decode_array(records[0]["detail"]["X"])
+    assert back.dtype == X.dtype
+    np.testing.assert_array_equal(back, X)  # bit-exact round trip
+
+
+def test_journal_torn_tail_is_dropped_and_noted(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path)
+    j.append("submit", "r1", {})
+    j.append("start", "r1", {})
+    # crash mid-append: chop the last record in half
+    with open(path, "rb+") as f:
+        data = f.read()
+        f.truncate(len(data) - len(data.splitlines()[-1]) // 2 - 1)
+    records, corrupt = RequestJournal(path).replay()
+    assert [r["event"] for r in records] == ["submit"]
+    assert len(corrupt) == 1 and corrupt[0]["torn_tail"]
+
+
+def test_journal_corrupt_middle_record_skipped_and_reported(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path)
+    j.append("submit", "r1", {"payload": "x" * 64})
+    j.append("submit", "r2", {})
+    j.append("start", "r1", {})
+    with open(path, "rb") as f:
+        first_len = len(f.readline())
+    flip_byte(path, first_len // 2)  # corrupt record 1 in place
+    records, corrupt = RequestJournal(path).replay()
+    assert [r["request_id"] for r in records] == ["r2", "r1"]
+    assert len(corrupt) == 1
+    assert not corrupt[0]["torn_tail"]
+    assert corrupt[0]["line"] == 1
+
+
+def test_journal_append_after_torn_tail_stays_readable(tmp_path):
+    """A post-restart append must not be glued onto a torn final line:
+    the acknowledged (fsync'd) new record has to survive a SECOND
+    crash-replay, or the durability contract is broken."""
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path)
+    j.append("submit", "r1", {})
+    j.append("start", "r1", {})
+    # crash mid-append: partial final record, no trailing newline
+    with open(path, "rb+") as f:
+        data = f.read()
+        f.truncate(len(data) - len(data.splitlines()[-1]) // 2 - 1)
+    j2 = RequestJournal(path)
+    j2.append("submit", "r2", {})
+    records, corrupt = RequestJournal(path).replay()
+    assert [r["request_id"] for r in records] == ["r1", "r2"]
+    assert len(corrupt) == 1  # the sealed torn line, still audited
+
+
+def test_journal_seq_continues_after_reopen(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path)
+    j.append("submit", "r1", {})
+    j2 = RequestJournal(path)
+    assert j2.append("start", "r1", {}) == 2
+
+
+def test_journal_rejects_unknown_event(tmp_path):
+    j = RequestJournal(str(tmp_path / "j.jsonl"))
+    with pytest.raises(ValueError):
+        j.append("launch", "r1", {})
+
+
+# ---------------------------------------------------------------------------
+# admission + ladder
+# ---------------------------------------------------------------------------
+
+
+def test_shape_bucket_rounds_up_power_of_two():
+    assert shape_bucket(10, 2) == (256, 2, 1)
+    assert shape_bucket(256, 2) == (256, 2, 1)
+    assert shape_bucket(257, 3, nout=2) == (512, 3, 2)
+    assert shape_bucket(5000, 1) == (8192, 1, 1)
+
+
+def test_admission_rejects_when_full_with_structured_error():
+    ac = AdmissionController(capacity=2)
+    d1 = ac.admit(n_rows=100, nfeatures=2)
+    ac.admit(n_rows=100, nfeatures=2)
+    with pytest.raises(ServerSaturated) as ei:
+        ac.admit(n_rows=100, nfeatures=2)
+    e = ei.value
+    assert e.retry_after_s > 0
+    assert e.queue_depth == 2 and e.capacity == 2
+    assert tuple(e.bucket) == (256, 2, 1)
+    assert e.to_dict()["error"] == "server_saturated"
+    ac.release(d1.bucket)
+    ac.admit(n_rows=100, nfeatures=2)  # slot freed
+
+
+def test_admission_bucket_class_bound():
+    ac = AdmissionController(capacity=4, bucket_capacity=1)
+    ac.admit(n_rows=100, nfeatures=2)
+    with pytest.raises(ServerSaturated):
+        ac.admit(n_rows=100, nfeatures=2)  # same class full
+    ac.admit(n_rows=5000, nfeatures=2)  # different class admitted
+
+
+def test_admission_retry_after_tracks_service_time():
+    ac = AdmissionController(capacity=2)
+    ac.admit(n_rows=10, nfeatures=1)
+    ac.admit(n_rows=10, nfeatures=1)
+    ac.observe_service_time(10.0)
+    with pytest.raises(ServerSaturated) as ei:
+        ac.admit(n_rows=10, nfeatures=1)
+    assert ei.value.retry_after_s >= 10.0
+
+
+def test_overload_ladder_levels_and_shed():
+    lad = OverloadLadder(shed_sample_at=0.5, shed_priority_at=0.75,
+                         reject_at=1.0, sample_fraction=0.5,
+                         min_sample_rows=16)
+    assert lad.level(0.0) == "normal"
+    assert lad.level(0.5) == "shed_sample"
+    assert lad.level(0.75) == "shed_priority"
+    assert lad.level(1.0) == "reject"
+    d = lad.apply(0.6, n_rows=1000, priority=0)
+    assert d["admit"] and d["sample_rows"] == 500 and d["priority"] == 0
+    d = lad.apply(0.8, n_rows=1000, priority=0)
+    assert d["admit"] and d["sample_rows"] == 500 and d["priority"] == 1
+    d = lad.apply(1.0, n_rows=1000, priority=0)
+    assert not d["admit"]
+    # the floor protects tiny datasets from being shredded
+    d = lad.apply(0.6, n_rows=20, priority=0)
+    assert d["sample_rows"] is None or d["sample_rows"] >= 16
+
+
+def test_ladder_audits_only_real_sheds():
+    lad = OverloadLadder(shed_sample_at=0.5, sample_fraction=0.5,
+                         min_sample_rows=64)
+    # at shed level but the dataset is already at/below the floor and
+    # priority is untouched: admitted unchanged → NOT a shed
+    d = lad.apply(0.6, n_rows=32, priority=0)
+    assert d["admit"] and d["sample_rows"] is None and d["priority"] == 0
+    assert lad.sheds_total == 0
+    # a real shed still counts
+    d = lad.apply(0.6, n_rows=1000, priority=0)
+    assert d["sample_rows"] == 500
+    assert lad.sheds_total == 1
+
+
+def test_ladder_threshold_validation():
+    with pytest.raises(ValueError):
+        OverloadLadder(shed_sample_at=0.9, shed_priority_at=0.5)
+    with pytest.raises(ValueError):
+        OverloadLadder(sample_fraction=0.0)
+
+
+def test_admission_readmit_bypasses_bounds():
+    ac = AdmissionController(capacity=1)
+    ac.admit(n_rows=10, nfeatures=1)
+    # journal-replayed acceptances must never be refused
+    ac.readmit((256, 1, 1))
+    assert ac.depth == 2
+    with pytest.raises(ServerSaturated):
+        ac.admit(n_rows=10, nfeatures=1)
+
+
+# ---------------------------------------------------------------------------
+# canonical options fingerprint (executable-cache key)
+# ---------------------------------------------------------------------------
+
+
+def _opts(**kw):
+    base = dict(binary_operators=["+", "*"], unary_operators=[],
+                maxsize=8, populations=2, population_size=8,
+                tournament_selection_n=4)
+    base.update(kw)
+    return Options(**base)
+
+
+def test_options_fingerprint_stable_across_instances():
+    assert options_fingerprint(_opts()) == options_fingerprint(_opts())
+
+
+def test_options_fingerprint_ignores_host_only_fields():
+    a = _opts()
+    b = _opts(output_directory="/elsewhere", telemetry=True, verbosity=2,
+              seed=99, max_retries=7, interactive_quit=False)
+    assert options_fingerprint(a) == options_fingerprint(b)
+
+
+def test_options_fingerprint_sees_numeric_and_operator_changes():
+    base = options_fingerprint(_opts())
+    assert options_fingerprint(_opts(maxsize=10)) != base
+    assert options_fingerprint(_opts(parsimony=0.1)) != base
+    assert options_fingerprint(
+        _opts(binary_operators=["+", "-"])) != base
+
+
+def test_options_fingerprint_uncacheable_for_opaque_callables():
+    # a C callable has no __code__ → must refuse to fingerprint rather
+    # than risk a silent hyperparameter collision
+    assert options_fingerprint(_opts(elementwise_loss=abs)) is None
+
+
+def test_options_fingerprint_distinguishes_loss_closures():
+    a = options_fingerprint(_opts(elementwise_loss="huber"))
+    from symbolicregression_jl_tpu.core.losses import huber_loss
+
+    b = options_fingerprint(_opts(elementwise_loss=huber_loss(2.0)))
+    assert a is not None and b is not None and a != b
+
+
+def test_options_fingerprint_distinguishes_kwonly_defaults():
+    # identical co_code + empty closure/defaults, differing only in
+    # __kwdefaults__ — must not collide (and share a cached engine)
+    def make(delta):
+        def loss(p, t, *, d=delta):
+            return abs(p - t) * d
+        return loss
+
+    a = options_fingerprint(_opts(elementwise_loss=make(1.0)))
+    b = options_fingerprint(_opts(elementwise_loss=make(2.0)))
+    assert a is not None and b is not None and a != b
+
+
+def test_options_fingerprint_bound_method_receiver_state():
+    # a bound method's behavior depends on its receiver; arbitrary
+    # receiver state has no canonical form → uncacheable, not a digest
+    class Scaler:
+        def __init__(self, s):
+            self.s = s
+
+        def loss(self, p, t):
+            return abs(p - t) * self.s
+
+    assert options_fingerprint(
+        _opts(elementwise_loss=Scaler(2.0).loss)) is None
+
+
+_FP_GLOBAL_SCALE = 2.0
+
+
+def _loss_reading_global(p, t):
+    return _FP_GLOBAL_SCALE * abs(p - t)
+
+
+def test_options_fingerprint_rejects_nonmodule_global_reads():
+    # a module-level constant can be rebound without changing co_code —
+    # no process-stable canonical form → uncacheable, not a collision
+    assert options_fingerprint(
+        _opts(elementwise_loss=_loss_reading_global)) is None
+
+
+def _loss_reading_global_in_genexpr(p, t):
+    return sum(_FP_GLOBAL_SCALE * x for x in [abs(p - t)])
+
+
+def test_options_fingerprint_rejects_global_reads_in_nested_code():
+    # the global read happens inside the genexpr's own code object —
+    # the guard must recurse into co_consts, not just scan the outer
+    # co_names
+    assert options_fingerprint(
+        _opts(elementwise_loss=_loss_reading_global_in_genexpr)) is None
+
+
+# ---------------------------------------------------------------------------
+# serve fault plan plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_serve_fault_plan_env_roundtrip(monkeypatch):
+    from symbolicregression_jl_tpu.shield import faults
+
+    plan = faults.ServeFaultPlan(
+        kill_server_at_request=2, corrupt_journal_record=3,
+        cancel_request_at_iteration=(1, 2))
+    text = json.dumps({
+        "kill_server_at_request": 2, "corrupt_journal_record": 3,
+        "cancel_request_at_iteration": [1, 2],
+    })
+    assert faults.ServeFaultPlan.from_json(text) == plan
+    monkeypatch.setenv("SR_SERVE_FAULT_PLAN", text)
+    inj = faults.active_serve_injector()
+    assert inj is not None and inj.plan == plan
+
+
+def test_serve_injector_audits_injections_with_request_id():
+    """Every injection (incl. those carrying a request_id) must reach
+    the telemetry sink — a dropped audit makes the fault trail lie."""
+    from symbolicregression_jl_tpu.shield import faults
+
+    class Sink:
+        def __init__(self):
+            self.events = []
+
+        def serve(self, kind, request_id, **detail):
+            self.events.append((kind, request_id, detail))
+
+    sink = Sink()
+    inj = faults.ServeFaultInjector(
+        faults.ServeFaultPlan(cancel_request_at_iteration=(1, 2)),
+        telemetry=sink)
+    assert inj.should_cancel(1, 2, "rX")
+    assert sink.events == [
+        ("injected", "rX",
+         {"fault": "cancel_request", "index": 1, "iteration": 2})]
+
+
+def test_serve_injector_corrupts_exact_journal_record(tmp_path):
+    from symbolicregression_jl_tpu.shield import faults
+
+    inj = faults.ServeFaultInjector(
+        faults.ServeFaultPlan(corrupt_journal_record=2))
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path, injector=inj)
+    j.append("submit", "r1", {})
+    j.append("submit", "r2", {})
+    j.append("submit", "r3", {})
+    records, corrupt = RequestJournal(path).replay()
+    assert [r["request_id"] for r in records] == ["r1", "r3"]
+    assert len(corrupt) == 1 and corrupt[0]["line"] == 2
+    assert inj.injected and inj.injected[0][0] == "corrupt_journal"

@@ -321,6 +321,98 @@ def test_preemption_guard_sets_flag_and_restores_handlers():
     assert signal.getsignal(signal.SIGTERM) is before
 
 
+def test_preemption_guard_refcounted_nesting():
+    """Nested/concurrent guards in one process (the multi-tenant serve
+    worker case): inner install/uninstall must not clobber the outer
+    handlers; one signal is observed by every attached guard; the LAST
+    detach restores the original handlers."""
+    import signal
+
+    from symbolicregression_jl_tpu.shield.signals import PreemptionGuard
+
+    before = signal.getsignal(signal.SIGTERM)
+    outer = PreemptionGuard().install()
+    ours = signal.getsignal(signal.SIGTERM)
+    inner = PreemptionGuard().install()
+    assert signal.getsignal(signal.SIGTERM) is ours  # not re-wrapped
+    inner.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is ours  # outer still live
+    inner2 = PreemptionGuard().install()
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert outer.requested and inner2.requested  # shared observation
+    inner2.uninstall()
+    assert outer.requested  # flag survives a partial detach
+    outer.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is before
+    # a fresh attach cycle starts clean (no stale preempt flag)
+    with PreemptionGuard() as g:
+        assert not g.requested
+
+
+def test_preemption_guard_worker_thread_observes_main_install():
+    """A guard attached from a worker thread (where Python forbids
+    signal.signal) still sees a signal captured by the main thread's
+    installation — how a search inside a serve worker learns about the
+    server's SIGTERM."""
+    import signal
+    import threading
+
+    from symbolicregression_jl_tpu.shield.signals import PreemptionGuard
+
+    seen = {}
+
+    def worker(ready, fired):
+        g = PreemptionGuard().install()
+        seen["installed_handlers"] = g.installed
+        ready.set()
+        fired.wait(timeout=5)
+        seen["requested"] = g.requested
+        g.uninstall()
+
+    with PreemptionGuard():
+        ready, fired = threading.Event(), threading.Event()
+        t = threading.Thread(target=worker, args=(ready, fired))
+        t.start()
+        assert ready.wait(timeout=5)
+        os.kill(os.getpid(), signal.SIGTERM)
+        fired.set()
+        t.join(timeout=5)
+    assert seen["requested"] is True
+
+
+def test_unattended_signal_chains_to_original_disposition():
+    """When the LAST detach runs on a worker thread, handler restore is
+    deferred (Python forbids signal.signal off the main thread) — our
+    handlers stay installed with zero guards attached. A signal landing
+    in that window must NOT be silently swallowed by the flag-only
+    handler: it restores the original disposition and re-delivers, so
+    e.g. an operator's SIGINT/SIGTERM of an idle server still works."""
+    import signal
+    import threading
+    import time
+
+    import pytest
+
+    from symbolicregression_jl_tpu.shield.signals import PreemptionGuard
+
+    before = signal.getsignal(signal.SIGINT)
+    g = PreemptionGuard().install()
+    t = threading.Thread(target=g.uninstall)
+    t.start()
+    t.join(timeout=5)
+    # deferred restore: our handler is still installed, nobody attached
+    assert signal.getsignal(signal.SIGINT) is not before
+    with pytest.raises(KeyboardInterrupt):
+        os.kill(os.getpid(), signal.SIGINT)
+        for _ in range(100):  # let the re-delivered signal land
+            time.sleep(0.01)
+    assert signal.getsignal(signal.SIGINT) is before
+    # a fresh attach cycle after the chained restore starts clean
+    with PreemptionGuard() as g2:
+        assert not g2.requested
+    assert signal.getsignal(signal.SIGINT) is before
+
+
 def test_fault_plan_env_roundtrip(monkeypatch):
     plan = faults.FaultPlan(raise_on_dispatch=3, raise_count=2,
                             nan_poison_island=(1, 4))

@@ -50,6 +50,53 @@ def test_watcher_inactive_on_non_tty():
     assert not w.check()
 
 
+def test_disabled_watcher_never_touches_stdin_or_threads():
+    import threading
+
+    n_threads = threading.active_count()
+    w = StdinQuitWatcher.disabled()
+    assert not w.active
+    assert not w.check()
+    assert w.stream is None
+    assert threading.active_count() == n_threads
+    w.stop()  # no-op, must not raise
+
+
+def test_interactive_quit_flag_disables_watcher_construction():
+    """Options(interactive_quit=False) — the graftserve setting — must
+    route equation_search to the disabled watcher; an explicit injected
+    input_stream still wins (tests rely on it)."""
+    import io
+    from unittest import mock
+
+    X, y = _problem(50)
+    built = []
+    real_disabled = StdinQuitWatcher.disabled.__func__
+
+    def spy_disabled(cls):
+        built.append("disabled")
+        return real_disabled(cls)
+
+    with mock.patch.object(
+            StdinQuitWatcher, "disabled", classmethod(spy_disabled)):
+        equation_search(
+            X, y, options=_options(interactive_quit=False,
+                                   save_to_file=False),
+            runtime_options=RuntimeOptions(niterations=1, verbosity=0,
+                                           seed=0),
+        )
+    assert built == ["disabled"]
+
+    # force path: injected stream engages the watcher regardless
+    hofq = equation_search(
+        X, y, options=_options(interactive_quit=False, save_to_file=False),
+        runtime_options=RuntimeOptions(
+            niterations=30, verbosity=0, seed=0,
+            input_stream=io.StringIO("q")),
+    )
+    assert hofq is not None
+
+
 @pytest.mark.slow
 def test_user_quit_stops_search(capsys):
     X, y = _problem()

@@ -1,0 +1,118 @@
+"""`telemetry report` per-request view (docs/SERVING.md): graftscope.v1
+records grouped by run_id/request_id, serve-event aggregates, and the
+executable-cache hit-rate accounting — over a hand-built two-request
+journal fixture (no jax involved)."""
+
+import json
+
+from symbolicregression_jl_tpu.telemetry.report import (
+    format_report,
+    summarize,
+    summarize_requests,
+)
+from symbolicregression_jl_tpu.telemetry.schema import (
+    SCHEMA_VERSION,
+    validate_lines,
+)
+
+
+def _ev(event, t, **fields):
+    return {"schema": SCHEMA_VERSION, "t": t, "event": event, **fields}
+
+
+def _serve(t, kind, rid, **detail):
+    return _ev("serve", t, kind=kind, request_id=rid, detail=detail)
+
+
+def _fixture_events():
+    """A serve stream for two requests: req-a completes with a cache
+    miss; req-b completes with a cache hit after a retry fault."""
+    return [
+        _serve(1.0, "accept", "req-a", bucket=[256, 2, 1], priority=0),
+        _serve(1.1, "accept", "req-b", bucket=[256, 2, 1], priority=0),
+        _serve(1.2, "start", "req-a"),
+        _serve(1.3, "cache_miss", "req-a", bucket=[256, 2, 1]),
+        _serve(5.0, "done", "req-a"),
+        _serve(5.1, "start", "req-b"),
+        _serve(5.2, "cache_hit", "req-b", bucket=[256, 2, 1]),
+        _ev("fault", 6.0, kind="retry", iteration=2,
+            detail={"request_id": "req-b", "attempt": 1}),
+        _serve(8.0, "done", "req-b"),
+        _serve(9.0, "reject", "req-c", retry_after_s=5.0,
+               queue_depth=2),
+    ]
+
+
+def test_fixture_validates_against_schema():
+    lines = [json.dumps(e) for e in _fixture_events()]
+    assert validate_lines(lines) == []
+
+
+def test_requests_grouped_by_request_id():
+    groups = summarize_requests(_fixture_events())
+    assert set(groups) == {"req-a", "req-b", "req-c"}
+    a, b = groups["req-a"], groups["req-b"]
+    assert a["state"] == "done" and b["state"] == "done"
+    assert a["serve"] == {"accept": 1, "start": 1, "cache_miss": 1,
+                          "done": 1}
+    assert b["serve"]["cache_hit"] == 1
+    # the fault event reaches its request through detail.request_id
+    assert b["faults"] == {"retry": 1}
+    assert a["span_s"] == 4.0
+    assert groups["req-c"]["serve"] == {"reject": 1}
+
+
+def test_summary_serve_section_and_cache_hit_rate():
+    summary = summarize(_fixture_events())
+    sv = summary["serve"]
+    assert sv["accepted"] == 2 and sv["rejected"] == 1
+    assert sv["cache"] == {
+        "hits": 1, "misses": 1, "hit_rate": 0.5,
+        "by_bucket": {"[256, 2, 1]": {"hits": 1, "misses": 1,
+                                      "hit_rate": 0.5}},
+    }
+    assert set(summary["requests"]) == {"req-a", "req-b", "req-c"}
+
+
+def test_format_report_renders_per_request_lines():
+    text = format_report(summarize(_fixture_events()))
+    assert "serve: 2 accepted, 1 rejected" in text
+    assert "requests: 3" in text
+    assert "req-a: done" in text
+    assert "req-b: done" in text
+    assert "cache-hit" in text
+    assert "faults[retry=1]" in text
+
+
+def test_plain_search_stream_groups_by_run_id():
+    """Concatenated per-search streams (run_id on every event, hub.py)
+    group per run even without serve events."""
+    events = []
+    for rid, n in (("run-1", 2), ("run-2", 3)):
+        for i in range(1, n + 1):
+            events.append(_ev(
+                "iteration", float(i), run_id=rid, iteration=i,
+                num_evals=100.0 * i, evals_per_sec=1.0, elapsed_s=1.0,
+                device_s=0.5, host_s=0.1, host_fraction=0.1,
+                recompiles={"traces": 0, "backend_compiles": 0},
+                transfer_guard_hits=0, outputs=[]))
+        events.append(_ev(
+            "run_end", 99.0, run_id=rid, stop_reason="niterations",
+            iterations=n, num_evals=100.0 * n, elapsed_s=9.0,
+            recompiles_total={}))
+    summary = summarize(events)
+    groups = summary["requests"]
+    assert set(groups) == {"run-1", "run-2"}
+    assert groups["run-1"]["iterations"] == 2
+    assert groups["run-2"]["iterations"] == 3
+    assert groups["run-2"]["stop_reason"] == "niterations"
+
+
+def test_single_run_stream_has_no_requests_section():
+    events = [_ev(
+        "iteration", 1.0, run_id="solo", iteration=1, num_evals=1.0,
+        evals_per_sec=1.0, elapsed_s=1.0, device_s=0.5, host_s=0.1,
+        host_fraction=0.1,
+        recompiles={"traces": 0, "backend_compiles": 0},
+        transfer_guard_hits=0, outputs=[])]
+    assert "requests" not in summarize(events)

@@ -24,3 +24,6 @@ JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 
 echo "== graftshield: fault-injection smoke (docs/ROBUSTNESS.md) =="
 JAX_PLATFORMS=cpu python tools/fault_smoke.py
+
+echo "== graftserve: kill-restart-replay + overload smoke (docs/SERVING.md) =="
+JAX_PLATFORMS=cpu python tools/serve_smoke.py

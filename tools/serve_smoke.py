@@ -1,0 +1,254 @@
+"""CI serve smoke: graftserve's crash-safety + backpressure contracts,
+end to end on CPU (docs/SERVING.md; tools/check.sh and the CI
+``serve-smoke`` job)::
+
+    python tools/serve_smoke.py [out_base]
+
+Scenarios:
+
+1. **kill-restart-replay**: a subprocess server accepts 3 deterministic
+   requests and is SIGTERM'd (via the serve fault harness,
+   ``kill_server_at_request=2``) while request 2 is in flight. A fresh
+   subprocess over the same root replays the journal, resumes the
+   interrupted search from its shield checkpoints, and finishes all 3 —
+   every hall-of-fame fingerprint must be BIT-IDENTICAL to an unkilled
+   reference server's.
+2. **overload-reject**: a saturated queue (workers=0) rejects with a
+   structured :class:`ServerSaturated` carrying retry-after — no hang,
+   no unbounded queueing — and the rejection is audited as a ``serve``
+   telemetry event.
+3. **executable-cache**: N same-bucket repeat requests after a cold one
+   must all hit the engine cache (repeat hit rate 100%, overall >= 90%),
+   and ``telemetry report`` must agree.
+
+The subprocess phases reuse this file: ``--phase run`` creates (or
+recovers) a server over ``--root``, submits the standard request set
+when the journal is empty, drains, and prints a JSON result map.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SYMBOLIC_REGRESSION_IS_TESTING", "true")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+SEEDS = (5, 7, 9)
+NITER = 4
+
+
+def _problem():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2.0, 2.0, (128, 2)).astype(np.float32)
+    y = (X[:, 0] * 2.0 + X[:, 1] * X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options():
+    return dict(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=2,
+        tournament_selection_n=4,
+        optimizer_probability=0.0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# subprocess phase
+# ---------------------------------------------------------------------------
+
+
+def phase_run(root: str, kill_at: int) -> int:
+    """Create/recover a server over ``root``, drain it, print results."""
+    from symbolicregression_jl_tpu.serve import SearchServer
+    from symbolicregression_jl_tpu.shield import faults
+
+    if kill_at:
+        faults.install_serve(faults.ServeFaultInjector(
+            faults.ServeFaultPlan(kill_server_at_request=kill_at)))
+    X, y = _problem()
+    srv = SearchServer(root, capacity=8, workers=1)
+    if not srv.requests():  # fresh root: submit the standard set
+        for seed in SEEDS:
+            srv.submit(X, y, options=_options(), niterations=NITER,
+                       seed=seed, request_id=f"req-seed{seed}")
+    srv.start()
+    deadline = time.monotonic() + 600
+    while time.monotonic() < deadline:
+        if srv._preempt_requested():
+            # SIGTERM landed: stop at the boundary (emergency
+            # checkpoints written) and report the partial state
+            srv.stop(drain=False)
+            break
+        if srv.wait_idle(timeout=0.5):
+            srv.stop(drain=True)
+            break
+    out = {
+        s["request_id"]: {
+            "state": s["state"],
+            "fingerprint": (s["result"] or {}).get("fingerprint"),
+            "resumed": s["resumed"],
+        }
+        for s in srv.requests()
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def _run_subprocess(root: str, kill_at: int = 0) -> dict:
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--phase", "run", "--root", root]
+    if kill_at:
+        cmd += ["--kill-at", str(kill_at)]
+    env = dict(os.environ)
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=900, env=env)
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"phase run failed rc={proc.returncode}:\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+
+def scenario_kill_restart_replay(out_base: str) -> None:
+    ref_root = os.path.join(out_base, "ref")
+    kill_root = os.path.join(out_base, "kill")
+
+    ref = _run_subprocess(ref_root)
+    assert all(v["state"] == "done" for v in ref.values()), ref
+
+    partial = _run_subprocess(kill_root, kill_at=2)
+    unfinished = [r for r, v in partial.items() if v["state"] != "done"]
+    assert unfinished, f"kill fired too late — nothing in flight: {partial}"
+
+    resumed = _run_subprocess(kill_root)
+    assert all(v["state"] == "done" for v in resumed.values()), resumed
+    for rid, v in ref.items():
+        assert resumed[rid]["fingerprint"] == v["fingerprint"], (
+            f"{rid}: killed-and-restarted fingerprint differs from the "
+            f"unkilled run")
+
+    # recovery must be audited: replay serve events + journal intact
+    from symbolicregression_jl_tpu.telemetry.report import summarize
+    from symbolicregression_jl_tpu.telemetry.schema import load_events
+
+    events = load_events(os.path.join(kill_root, "serve_telemetry.jsonl"))
+    summary = summarize(events)
+    kinds = summary["serve"]["by_kind"]
+    assert kinds.get("replay", 0) >= 1, kinds
+    assert set(partial) <= set(summary["requests"]), summary["requests"]
+
+
+def scenario_overload_reject(out_base: str) -> None:
+    from symbolicregression_jl_tpu.serve import SearchServer, ServerSaturated
+
+    from symbolicregression_jl_tpu.shield.faults import active_serve_injector
+
+    X, y = _problem()
+    root = os.path.join(out_base, "overload")
+    srv = SearchServer(root, capacity=2, workers=0)  # never drains
+    for i in range(2):
+        srv.submit(X, y, options=_options(), niterations=2, seed=i)
+    # storm size: the queue_overflow_storm knob of an active
+    # SR_SERVE_FAULT_PLAN, else a default burst — EVERY storm submit
+    # must reject promptly (no hang, no queue growth)
+    inj = active_serve_injector()
+    storm = (inj.plan.queue_overflow_storm
+             if inj is not None and inj.plan.queue_overflow_storm
+             else 5)
+    t0 = time.monotonic()
+    for k in range(storm):
+        try:
+            srv.submit(X, y, options=_options(), niterations=2,
+                       seed=99 + k)
+        except ServerSaturated as e:
+            assert e.retry_after_s > 0 and e.queue_depth == 2, e.to_dict()
+        else:
+            raise AssertionError("saturated queue did not reject")
+    assert time.monotonic() - t0 < 5.0 * storm, "reject path blocked"
+    assert srv.admission.depth == 2, "storm leaked admission slots"
+    with open(os.path.join(root, "serve_telemetry.jsonl")) as f:
+        assert any('"kind": "reject"' in l for l in f), (
+            "reject not audited in serve telemetry")
+
+
+def scenario_cache_hit_rate(out_base: str) -> None:
+    from symbolicregression_jl_tpu.serve import SearchServer
+    from symbolicregression_jl_tpu.telemetry.report import summarize
+    from symbolicregression_jl_tpu.telemetry.schema import load_events
+
+    X, y = _problem()
+    root = os.path.join(out_base, "cache")
+    srv = SearchServer(root, capacity=16, workers=1).start()
+    n_repeat = 10
+    rids = [
+        srv.submit(X, y, options=_options(), niterations=2, seed=100 + i)
+        for i in range(1 + n_repeat)
+    ]
+    for rid in rids:
+        s = srv.wait(rid, timeout=600)
+        assert s["state"] == "done", s
+    srv.stop(drain=True)
+    stats = srv.cache.stats()
+    assert stats["misses"] == 1 and stats["hits"] == n_repeat, stats
+    summary = summarize(
+        load_events(os.path.join(root, "serve_telemetry.jsonl")))
+    rate = summary["serve"]["cache"]["hit_rate"]
+    assert rate is not None and rate >= 0.9, (
+        f"reported executable-cache hit rate {rate} < 90%")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("out_base", nargs="?", default="/tmp/sr_serve_smoke")
+    parser.add_argument("--phase", choices=["run"], default=None)
+    parser.add_argument("--root", default=None)
+    parser.add_argument("--kill-at", type=int, default=0)
+    args = parser.parse_args()
+
+    if args.phase == "run":
+        return phase_run(args.root, args.kill_at)
+
+    # idempotent re-runs (tools/check.sh is run repeatedly on one box):
+    # every scenario rebuilds its root from scratch — a stale journal
+    # from a previous run would otherwise replay into this one
+    import shutil
+
+    for sub in ("ref", "kill", "overload", "cache"):
+        shutil.rmtree(os.path.join(args.out_base, sub),
+                      ignore_errors=True)
+
+    scenarios = [
+        ("kill-restart-replay-bit-identical", scenario_kill_restart_replay),
+        ("overload-structured-reject", scenario_overload_reject),
+        ("executable-cache-hit-rate", scenario_cache_hit_rate),
+    ]
+    for name, fn in scenarios:
+        try:
+            fn(args.out_base)
+        except Exception as e:  # noqa: BLE001 - report and fail the job
+            print(f"FAIL [{name}]: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"OK   [{name}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
