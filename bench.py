@@ -1,184 +1,26 @@
 """Headline benchmark: full-dataset expression evaluations per second.
 
-Mirrors the reference's primary live metric — "full dataset evaluations
-per second" (Δnum_evals/Δt, /root/reference/src/SymbolicRegression.jl:1158-1171)
-— on the reference benchmark problem (benchmarks.jl: 5 features, ops
-{+,-,*,/} ∪ {exp,abs}, maxsize=30, target
-cos(2.13x₁)+0.5x₂|x₃|^0.9−0.3|x₄|^1.5) scaled to the BASELINE.json
-north-star 10k-row dataset.
+Thin wrapper over :mod:`symbolicregression_jl_tpu.bench.headline` (the
+graftbench subsystem, docs/BENCHMARKING.md) kept at the repo root for
+the driver's round artifact (``python bench.py`` -> BENCH_r0N.json).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} —
+the contract ``python -m symbolicregression_jl_tpu.bench trend`` parses
+back out of the committed history.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The full benchmark matrix, regression gate, serve load benchmark, and
+trajectory report live in the subsystem CLI::
 
-`vs_baseline` compares against the MEASURED CPU-multithreaded rate:
-profiling/cpu_baseline.py measures a per-node-vectorized numpy
-evaluator at 8.1e3 evals/s *per core* on this host
-(transcendental-dominated, within a small factor of the reference's
-fused LoopVectorization interpreter per core), i.e. ~6.5e4 evals/s for
-an 8-core multithreaded host. Rounds 1-3 reported against a 1e4
-round-1 estimate (a 1-2-core rate); that legacy ratio is demoted to
-the `vs_baseline_legacy_1e4` field for cross-round continuity
-(BENCH_r01-r03 used it).
+    python -m symbolicregression_jl_tpu.bench run|gate|load|trend
 """
 
 from __future__ import annotations
 
-import json
-import time
+import os
+import sys
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-MEASURED_CPU_EVALS_PER_SEC = 6.5e4   # 8-core extrapolation, BASELINE.md
-LEGACY_CPU_EVALS_PER_SEC = 1.0e4     # round-1 estimate (1-2 cores)
-
-N_ROWS = 10_000
-N_FEATURES = 5
-WARMUP_ITERS = 1
-MEASURE_ITERS = 3
-
-
-def _v5e8_comm_efficiency(iter_seconds: float) -> "tuple[float, dict]":
-    """Communication-bound weak-scaling efficiency for a v5e-8 from the
-    closed-form ICI byte model (profiling/ici_model.py).
-
-    Islands are data-independent — the per-chip program at 512 local
-    islands is EXACTLY the measured single-chip program; the only
-    cross-chip traffic is the migration-pool all-gather + HoF merge +
-    stats psum. A virtual CPU mesh cannot measure this (its 'devices'
-    share the host cores, so per-device throughput mechanically drops
-    ~1/n); profiling/weak_scaling.py exists to (a) produce the real
-    number the day multi-chip hardware is attached and (b) validate
-    that the sharded program executes at 1..8 shards, which the driver's
-    dryrun_multichip also pins every round."""
-    import os as _os
-    import sys as _sys
-
-    _sys.path.insert(0, _os.path.join(
-        _os.path.dirname(_os.path.abspath(__file__)), "profiling"))
-    from ici_model import model
-
-    # Worst-case partitioner bound at the bench config, conservative
-    # 400 Gbit/s effective ICI (v5e raw per-chip is ~4x that);
-    # iter_seconds is THIS run's measured per-iteration wall time.
-    m = model(I=512 * 8, P=256, L=30, topn=12, maxsize=30, n_devices=8,
-              iter_seconds=iter_seconds, ici_gbps=400.0)
-    return m["weak_scaling_comm_efficiency_lower_bound"], {
-        "model": "profiling/ici_model.py worst-case partitioner bound",
-        "total_MB_per_iter_upper": m["total_MB_per_iter_upper"],
-        "measured_iter_seconds": round(iter_seconds, 2),
-        "ici_gbps_assumed": 400.0,
-    }
-
-
-def main() -> None:
-    import jax
-
-    from symbolicregression_jl_tpu import Options, search_key
-    from symbolicregression_jl_tpu.core.dataset import make_dataset
-    from symbolicregression_jl_tpu.evolve.engine import Engine
-    from symbolicregression_jl_tpu.telemetry.schema import SCHEMA_VERSION
-
-    rng = np.random.default_rng(0)
-    X = rng.uniform(-3.0, 3.0, (N_ROWS, N_FEATURES)).astype(np.float32)
-    y = (
-        np.cos(2.13 * X[:, 0])
-        + 0.5 * X[:, 1] * np.abs(X[:, 2]) ** 0.9
-        - 0.3 * np.abs(X[:, 3]) ** 1.5
-        + 1e-1 * rng.standard_normal(N_ROWS)
-    ).astype(np.float32)
-
-    # Island count is the TPU-native scaling axis (SURVEY.md §2.4): more
-    # islands amortize the per-cycle machinery over more concurrent
-    # evaluations in the same launches (profiling/config_sweep.py picks
-    # the per-chip config); with multiple devices visible the island
-    # axis shards over them — the multi-chip number is one
-    # `python bench.py` away, with 512 LOCAL islands per chip.
-    n_dev = len(jax.devices())
-    options = Options(
-        binary_operators=["+", "-", "*", "/"],
-        unary_operators=["exp", "abs", "cos"],
-        maxsize=30,
-        populations=512 * n_dev,  # island count peaks at 512 on v5e-1
-        population_size=256,  # (profiling/config_sweep.py, round 3)
-        tournament_selection_n=16,
-        ncycles_per_iteration=100,
-        save_to_file=False,
-    )
-    ds = make_dataset(X, y)
-    ds.update_baseline_loss(options.elementwise_loss)
-
-    mesh = None
-    if n_dev > 1:
-        from symbolicregression_jl_tpu.parallel.mesh import (
-            make_mesh, shard_device_data, shard_search_state)
-
-        mesh = make_mesh(jax.devices(), n_island_shards=n_dev)
-        engine = Engine(options, ds.nfeatures, n_island_shards=n_dev,
-                        mesh=mesh)
-        data = shard_device_data(ds.data, mesh)
-    else:
-        engine = Engine(options, ds.nfeatures)
-        data = ds.data
-
-    state = engine.init_state(
-        search_key(0), data, options.populations
-    )
-    if mesh is not None:
-        state = shard_search_state(state, mesh)
-
-    # Warmup (compile) iterations, excluded from timing.
-    for _ in range(WARMUP_ITERS):
-        state = engine.run_iteration(state, data, options.maxsize)
-    jax.block_until_ready(state.pops.cost)
-    evals_before = float(state.num_evals)
-
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_ITERS):
-        state = engine.run_iteration(state, data, options.maxsize)
-    jax.block_until_ready(state.pops.cost)
-    elapsed = time.perf_counter() - t0
-
-    evals = float(state.num_evals) - evals_before
-    rate = evals / elapsed
-    rec = {
-        "metric": "full_dataset_expr_evals_per_sec_10k_rows",
-        "value": round(rate, 1),
-        "unit": "evals/s",
-        "vs_baseline": round(rate / MEASURED_CPU_EVALS_PER_SEC, 3),
-        "vs_baseline_legacy_1e4": round(
-            rate / LEGACY_CPU_EVALS_PER_SEC, 3),
-        "n_devices": n_dev,
-        # Candidate-eval path provenance (round 6): the in-kernel
-        # loss->cost epilogue state and launch geometry, so headline
-        # deltas across rounds attribute to the right knob.
-        "fuse_cost_epilogue": bool(engine.cfg.fuse_cost),
-        "eval_tree_block": engine.cfg.eval_tree_block,
-        "eval_tile_rows": engine.cfg.eval_tile_rows,
-        # graftscope provenance (round 7): whether the device counters
-        # rode the measured iterations (they are off for the headline —
-        # the bench measures the bare hot loop) and the schema version a
-        # telemetry-enabled rerun of this config would emit, so bench
-        # JSON and telemetry JSONL from the same build can be joined.
-        "telemetry": {
-            "schema": SCHEMA_VERSION,
-            "counters_enabled": bool(engine.cfg.collect_telemetry),
-        },
-    }
-    if n_dev == 1:
-        # Projected v5e-8: measured single-chip rate x 8 devices x the
-        # communication-bound efficiency from the closed-form ICI model
-        # (the per-chip program at 512 local islands IS the measured
-        # single-chip program; migration/HoF collectives are the only
-        # cross-chip traffic, < 0.2% of iteration time at the
-        # partitioner's worst-case bound).
-        eff, src = _v5e8_comm_efficiency(elapsed / MEASURE_ITERS)
-        proj = rate * 8 * min(eff, 1.0)
-        rec["projected_v5e8"] = round(proj, 1)
-        rec["projected_v5e8_vs_baseline"] = round(
-            proj / MEASURED_CPU_EVALS_PER_SEC, 2)
-        rec["projection_comm_efficiency"] = round(min(eff, 1.0), 4)
-        rec["projection_source"] = src
-    print(json.dumps(rec))
-
+from symbolicregression_jl_tpu.bench.headline import main  # noqa: E402
 
 if __name__ == "__main__":
     main()

@@ -2,6 +2,11 @@
 
 Keeps every profile anchored to the same workload as bench.py (10k rows,
 5 features, ops {+,-,*,/,exp,abs,cos}, maxsize 30).
+
+Importing this module is ALSO the one sanctioned way a profiling script
+makes the repo-root package importable (``import _common`` replaces the
+per-script ``sys.path.insert`` preamble that used to be copy-pasted
+across profiling/*.py).
 """
 
 from __future__ import annotations
@@ -10,10 +15,18 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import numpy as np
+
+def repo_root_on_path() -> str:
+    """Idempotently put the repo root on ``sys.path`` so
+    ``symbolicregression_jl_tpu`` imports from the checkout."""
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    return REPO_ROOT
+
+
+repo_root_on_path()
 
 N_ROWS = 10_000
 N_FEATURES = 5
@@ -22,6 +35,12 @@ N_FEATURES = 5
 def make_bench_problem(n_rows: int = N_ROWS, nfeatures: int = N_FEATURES,
                        **options_kw):
     """(options, dataset, engine) on the bench workload."""
+    # jax/numpy imported lazily: `import _common` is also the path
+    # preamble of host-only scripts (cpu_baseline, the
+    # compile_breakdown orchestrator) that must not pay — or trigger —
+    # a module-scope jax import just to find the repo root
+    import numpy as np
+
     from symbolicregression_jl_tpu import Options
     from symbolicregression_jl_tpu.core.dataset import make_dataset
     from symbolicregression_jl_tpu.evolve.engine import Engine
@@ -53,6 +72,8 @@ def timeit(fn, *args, n=10, warmup=2):
     Only valid for measuring launch *throughput*; per-call latency on the
     tunneled TPU is meaningless (see .claude/skills/verify gotchas).
     """
+    import jax
+
     for _ in range(warmup):
         out = fn(*args)
     jax.block_until_ready(out)

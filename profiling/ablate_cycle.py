@@ -12,12 +12,11 @@ Run: python profiling/ablate_cycle.py [islands] [ncycles]
 from __future__ import annotations
 
 import dataclasses
-import os
 import sys
 import time
 from functools import partial
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: F401,E402  (repo root on sys.path)
 
 import jax
 import jax.numpy as jnp

@@ -19,8 +19,7 @@ import subprocess
 import sys
 import time
 
-HERE = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, os.path.dirname(HERE))
+import _common  # noqa: F401,E402  (repo root on sys.path)
 
 
 def child():

@@ -17,12 +17,11 @@ from __future__ import annotations
 import concurrent.futures as cf
 import json
 import os
-import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: F401,E402  (repo root on sys.path)
 
 
 def main():

@@ -32,13 +32,12 @@ Usage: python profiling/cycle_attrib.py [I] [P] [NC] [reps]
 from __future__ import annotations
 
 import math
-import os
 import re
 import sys
 import time
 from collections import Counter
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: F401,E402  (repo root on sys.path)
 
 import jax
 import jax.numpy as jnp

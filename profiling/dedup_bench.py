@@ -8,11 +8,10 @@ Usage: dedup_bench.py [islands] [pop] [V]
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: F401,E402  (repo root on sys.path)
 
 import jax
 import jax.numpy as jnp

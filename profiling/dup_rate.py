@@ -23,10 +23,9 @@ Usage: dup_rate.py [islands] [pop] [cycles_to_sample] [warm_iters]
 
 from __future__ import annotations
 
-import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: F401,E402  (repo root on sys.path)
 
 import jax
 import jax.numpy as jnp

@@ -21,11 +21,10 @@ Usage: opt_bench.py [n_iters] [n_chain] [--exact]
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: F401,E402  (repo root on sys.path)
 
 import dataclasses
 

@@ -6,11 +6,10 @@ Usage: prog_bench.py [T] [TB] [avg_len]
 from __future__ import annotations
 
 import dataclasses
-import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: F401,E402  (repo root on sys.path)
 
 import jax
 import jax.numpy as jnp

@@ -11,7 +11,7 @@ import sys
 import time
 from collections import defaultdict
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: F401,E402  (repo root on sys.path)
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,57 @@
+"""graftbench — continuous benchmark matrix + perf/quality regression gate.
+
+The repo's perf/quality safety net (ROADMAP item 5, docs/BENCHMARKING.md):
+
+- ``python -m symbolicregression_jl_tpu.bench run`` executes a small
+  fixed matrix (plain / template / parametric / island-sharded x seeds;
+  CPU-sized shapes by default, chip-sized with ``--full``) with
+  graftscope telemetry on, and extracts per-cell metrics — evals/s,
+  best loss, host-fraction, recompile count, pareto volume — from the
+  telemetry JSONL rather than ad-hoc timers (bench/extract.py over
+  telemetry/report.py's machine-readable metrics view).
+- ``... bench gate`` diffs a fresh matrix result against the committed
+  schema-versioned baseline (benchmarks/baseline.json) using per-metric
+  noise bands calibrated from repeated seed runs, and exits nonzero on
+  regression beyond band: quality regressions gate hard, throughput
+  regressions gate with a wider band on CPU (bench/gate.py).
+- ``... bench load`` is the serve-level benchmark: a sustained
+  submit/poll storm against a real :class:`~..serve.SearchServer`,
+  reporting requests/s, p99 poll latency, executable-cache hit rate,
+  and shed fraction (bench/load.py).
+- ``... bench trend`` folds the committed BENCH_r0*.json /
+  MULTICHIP_r0*.json history plus gate results into one trajectory
+  report, flagging red artifacts (nonzero rc) explicitly instead of
+  silently skipping them (bench/trend.py).
+
+The repo-root ``bench.py`` headline benchmark is a thin wrapper over
+:mod:`.headline` and keeps its one-line JSON contract.
+"""
+
+from __future__ import annotations
+
+from .extract import extract_metrics
+from .gate import (
+    BASELINE_SCHEMA,
+    GATED_METRICS,
+    calibrate_bands,
+    diff_result,
+    load_baseline,
+    make_baseline,
+)
+from .matrix import MATRIX_SHAPES, RESULT_SCHEMA, matrix_cells, run_matrix
+from .projection import v5e8_comm_efficiency
+
+__all__ = [
+    "BASELINE_SCHEMA",
+    "GATED_METRICS",
+    "MATRIX_SHAPES",
+    "RESULT_SCHEMA",
+    "calibrate_bands",
+    "diff_result",
+    "extract_metrics",
+    "load_baseline",
+    "make_baseline",
+    "matrix_cells",
+    "run_matrix",
+    "v5e8_comm_efficiency",
+]

@@ -1,0 +1,236 @@
+"""graftbench CLI: ``python -m symbolicregression_jl_tpu.bench <cmd>``.
+
+Commands (docs/BENCHMARKING.md):
+
+- ``run``   — execute the benchmark matrix, write the result JSON, and
+  optionally pin it as a new baseline (``--baseline-out``, with noise
+  bands calibrated from ``--repeats``).
+- ``gate``  — run a fresh matrix and diff it against the committed
+  baseline; exits nonzero on regression beyond band (the CI job).
+- ``load``  — the serve-level submit/poll storm benchmark.
+- ``trend`` — fold BENCH_r0*/MULTICHIP_r0* history + gate results into
+  one trajectory report (red artifacts flagged, never dropped).
+- ``_cell`` — internal: one matrix cell in a clean subprocess.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _write_json(path: Optional[str], payload: dict, log=print) -> None:
+    if not path:
+        return
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1)
+    log(f"wrote {path}")
+
+
+def _add_matrix_args(p: argparse.ArgumentParser) -> None:
+    from .cell import VARIANTS
+
+    p.add_argument("--full", action="store_true",
+                   help="chip-sized shapes (default: CPU mini matrix)")
+    p.add_argument("--variants", nargs="+", default=list(VARIANTS),
+                   choices=list(VARIANTS), metavar="VARIANT")
+    p.add_argument("--seeds", nargs="+", type=int, default=[0, 1])
+    p.add_argument("--workdir", default=None,
+                   help="scratch dir for cell runs/telemetry "
+                        "(default $TMPDIR/graftbench)")
+
+
+def cmd_run(args) -> int:
+    from .gate import calibrate_bands, make_baseline
+    from .matrix import run_matrix
+
+    matrix = "full" if args.full else "mini"
+    results = []
+    for rep in range(max(args.repeats, 1)):
+        print(f"matrix run {rep + 1}/{args.repeats} ({matrix}):")
+        results.append(run_matrix(
+            matrix=matrix, variants=args.variants, seeds=args.seeds,
+            workdir=args.workdir))
+    result = results[-1]
+    _write_json(args.out, result)
+    # failures from ANY repeat fail the run: a cell that crashed in an
+    # earlier repeat would otherwise silently degrade the calibration
+    # (fewer samples per cell) behind a green exit code
+    failed_cells = sorted(
+        {cid for r in results for cid in r["failures"]})
+    if args.baseline_out:
+        if failed_cells:
+            print(f"refusing to pin a baseline: cell(s) failed in at "
+                  f"least one repeat: {', '.join(failed_cells)}",
+                  file=sys.stderr)
+        else:
+            try:
+                baseline = make_baseline(
+                    results, calibrate_bands(results))
+            except ValueError as e:  # non-finite gated metric
+                print(str(e), file=sys.stderr)
+                return 1
+            _write_json(args.baseline_out, baseline)
+    if failed_cells:
+        print(f"{len(failed_cells)} cell(s) failed across "
+              f"{len(results)} repeat(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_gate(args) -> int:
+    from .gate import (diff_result, format_findings, gate_failed,
+                       load_baseline)
+    from .matrix import run_matrix
+
+    try:
+        baseline = load_baseline(args.baseline)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"gate: cannot load baseline: {e}", file=sys.stderr)
+        return 2
+    from .cell import VARIANTS
+    from .matrix import DEFAULT_SEEDS, matrix_cells
+
+    cells_filter = None
+    if (tuple(args.variants) != VARIANTS
+            or tuple(args.seeds) != DEFAULT_SEEDS):
+        # a deliberately sliced gate (fresh run OR --result of a
+        # sliced run) diffs only what was asked for — the cells it
+        # was ASKED to skip are not "missing". The slice must
+        # actually intersect the baseline (checked BEFORE spending
+        # minutes running it): an empty intersection would "PASS"
+        # having compared nothing.
+        requested = [cid for cid, _, _ in matrix_cells(
+            args.variants, args.seeds)]
+        cells_filter = [cid for cid in requested
+                        if cid in baseline.get("cells", {})]
+        if not cells_filter:
+            print(f"gate: requested slice {requested} matches no "
+                  f"baseline cell — nothing to gate", file=sys.stderr)
+            return 2
+        print(f"gate: PARTIAL — diffing {len(cells_filter)} of "
+              f"{len(baseline.get('cells', {}))} baseline cells")
+    if args.result:
+        with open(args.result) as f:
+            result = json.load(f)
+    else:
+        matrix = "full" if args.full else "mini"
+        if matrix != baseline.get("matrix"):
+            print(f"gate: baseline is a {baseline.get('matrix')!r} "
+                  f"matrix; pass the matching flags", file=sys.stderr)
+            return 2
+        print(f"gate: running fresh {matrix} matrix "
+              f"against {args.baseline}")
+        result = run_matrix(
+            matrix=matrix, variants=args.variants, seeds=args.seeds,
+            workdir=args.workdir)
+    findings = diff_result(result, baseline, cells_filter=cells_filter)
+    payload = dict(result)
+    payload["gate"] = {
+        "baseline": args.baseline,
+        "findings": [f.to_dict() for f in findings],
+        "failed": gate_failed(findings),
+    }
+    _write_json(args.out, payload)
+    print(format_findings(findings, verbose=args.verbose))
+    return 1 if gate_failed(findings) else 0
+
+
+def cmd_load(args) -> int:
+    from .load import run_load
+
+    report = run_load(
+        args.root, requests=args.requests, workers=args.workers,
+        capacity=args.capacity, rows=args.rows,
+        niterations=args.niterations, timeout_s=args.timeout,
+    )
+    _write_json(args.out, report)
+    if not report["ok"]:
+        print(f"load: {report['failed']} failed / "
+              f"{report['unfinished']} unfinished / "
+              f"{args.requests - report['submitted']} never-admitted "
+              f"request(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_trend(args) -> int:
+    from .trend import build_trend, format_trend
+
+    trend = build_trend(args.root, gate_paths=args.gate or None)
+    if args.json:
+        print(json.dumps(trend))
+    else:
+        print(format_trend(trend))
+    return 1 if (args.strict and trend["red_count"]) else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m symbolicregression_jl_tpu.bench",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("run", help="run the benchmark matrix")
+    _add_matrix_args(p)
+    p.add_argument("--repeats", type=int, default=1,
+                   help="repeat the matrix N times (band calibration)")
+    p.add_argument("--out", default=None, help="result JSON path")
+    p.add_argument("--baseline-out", default=None,
+                   help="pin the run(s) as a new baseline at this path")
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("gate", help="diff a fresh matrix vs baseline")
+    _add_matrix_args(p)
+    p.add_argument("--baseline", default="benchmarks/baseline.json")
+    p.add_argument("--result", default=None,
+                   help="gate a precomputed result file instead of "
+                        "running the matrix")
+    p.add_argument("--out", default=None,
+                   help="write result+findings JSON here (CI artifact)")
+    p.add_argument("--verbose", action="store_true",
+                   help="also print in-band (ok) comparisons")
+    p.set_defaults(fn=cmd_gate)
+
+    p = sub.add_parser("load", help="serve submit/poll storm benchmark")
+    p.add_argument("--root", default=os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "graftbench_load"))
+    p.add_argument("--requests", type=int, default=10)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--capacity", type=int, default=4)
+    p.add_argument("--rows", type=int, default=160)
+    p.add_argument("--niterations", type=int, default=1)
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--out", default=None, help="report JSON path")
+    p.set_defaults(fn=cmd_load)
+
+    p = sub.add_parser("trend", help="benchmark trajectory report")
+    p.add_argument("--root", default=".",
+                   help="repo root holding BENCH_r0*/MULTICHIP_r0*")
+    p.add_argument("--gate", nargs="*", default=None,
+                   help="extra gate result JSON files to fold in")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--strict", action="store_true",
+                   help="exit nonzero when any red artifact exists")
+    p.set_defaults(fn=cmd_trend)
+
+    p = sub.add_parser("_cell")  # internal subprocess entry
+    p.add_argument("spec")
+    p.set_defaults(fn=None)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "_cell":
+        from .cell import cell_main
+
+        return cell_main(args.spec)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

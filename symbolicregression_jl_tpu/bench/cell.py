@@ -1,0 +1,146 @@
+"""One matrix cell: a small, fully-specified search run with telemetry on.
+
+Executed in a SUBPROCESS per cell (``python -m
+symbolicregression_jl_tpu.bench _cell '<spec json>'``) so every cell
+gets a clean jax session — the sharded cell needs
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` set before jax
+imports, and per-cell process isolation keeps one cell's compile cache
+pollution, retrace state, or crash from contaminating the rest of the
+matrix. The parent (bench/matrix.py) sets the env and parses the
+``GRAFTBENCH_CELL`` JSON line this module prints.
+
+Metrics come from the cell's graftscope telemetry JSONL via
+bench/extract.py — not from ad-hoc timers — so the gate measures
+exactly what production observability reports.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Any, Dict
+
+CELL_SENTINEL = "GRAFTBENCH_CELL"
+
+# Mini (CPU/CI) shapes: small enough that a full 4x2 matrix stays in
+# CI budget (each cell ~10-45 s on CPU incl. trace; the persistent
+# compile cache below makes repeat geometries cheap), big enough that
+# quality metrics move when the search regresses. Chip-sized shapes
+# (--full) mirror the bench.py headline config.
+MINI = dict(rows=128, populations=4, population_size=16,
+            ncycles=8, maxsize=8, niterations=3,
+            tournament_selection_n=4, shards=2)
+FULL = dict(rows=10_000, populations=512,
+            population_size=256, ncycles=100, maxsize=30, niterations=3,
+            tournament_selection_n=16, shards=0)  # 0 = all devices
+
+VARIANTS = ("plain", "template", "parametric", "sharded")
+
+
+def _problem(shape: Dict[str, Any], variant: str):
+    """Deterministic per-variant problem. The rng seed is FIXED (1234):
+    the search seed varies across matrix cells, the data never does —
+    quality deltas then attribute to the search, not the sample."""
+    import numpy as np
+
+    rng = np.random.default_rng(1234)
+    n = int(shape["rows"])
+    X = rng.uniform(-2.0, 2.0, (n, 2)).astype(np.float32)
+    extra = None
+    if variant == "template":
+        # truth matches the template structure f(x1)^2 + g(x2)
+        y = ((1.5 * X[:, 0]) ** 2 + np.cos(2.0 * X[:, 1])
+             ).astype(np.float32)
+    elif variant == "parametric":
+        category = rng.integers(0, 3, n)
+        amp = np.array([1.0, 2.0, 3.0], np.float32)[category]
+        y = (amp * np.cos(X[:, 0]) + X[:, 1]).astype(np.float32)
+        extra = {"class": category}
+    else:  # plain / sharded share the problem; only the mesh differs
+        y = (np.cos(2.13 * X[:, 0]) + 0.5 * X[:, 1]).astype(np.float32)
+    return X, y, extra
+
+
+def _options(shape: Dict[str, Any], variant: str, out_dir: str):
+    from ..core.options import Options
+    from ..models import template_spec
+    from ..models.spec import ParametricExpressionSpec
+
+    spec = None
+    if variant == "template":
+        spec = template_spec(expressions=("f", "g"))(
+            lambda f, g, x1, x2: f(x1) * f(x1) + g(x2)
+        )
+    elif variant == "parametric":
+        spec = ParametricExpressionSpec(max_parameters=1)
+    return Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        maxsize=int(shape["maxsize"]),
+        populations=int(shape["populations"]),
+        population_size=int(shape["population_size"]),
+        ncycles_per_iteration=int(shape["ncycles"]),
+        tournament_selection_n=int(shape["tournament_selection_n"]),
+        optimizer_probability=0.0,  # keep mini cells deterministic-fast
+        expression_spec=spec,
+        output_directory=out_dir,
+        telemetry=True,
+    )
+
+
+def run_cell(spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Run one cell per its JSON spec; returns the cell result record
+    (metrics extracted from the telemetry JSONL)."""
+    # Persistent XLA compile cache: matrix cells are subprocesses, and
+    # without it every cell would pay full compile for an identical
+    # geometry (quality_bench.py sets the same knob for its legs).
+    import jax
+
+    cache = os.path.join(
+        tempfile.gettempdir(), f"jax_graftbench_cache_{os.getuid()}")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from ..api.search import equation_search
+    from ..telemetry.schema import load_events
+    from .extract import extract_metrics
+
+    variant = spec["variant"]
+    seed = int(spec["seed"])
+    shape = dict(spec["shape"])
+    cell_id = spec["cell_id"]
+    out_dir = spec["out_dir"]
+    run_id = cell_id.replace("/", "_")
+
+    X, y, extra = _problem(shape, variant)
+    options = _options(shape, variant, out_dir)
+
+    t0 = time.perf_counter()
+    equation_search(
+        X, y, options=options, extra=extra,
+        niterations=int(shape["niterations"]),
+        verbosity=0, run_id=run_id, seed=seed,
+    )
+    wall_s = time.perf_counter() - t0
+
+    telemetry_path = os.path.join(out_dir, run_id, "telemetry.jsonl")
+    metrics = extract_metrics(load_events(telemetry_path))
+    return {
+        "cell_id": cell_id,
+        "variant": variant,
+        "seed": seed,
+        "n_devices": len(jax.devices()),
+        "backend": jax.default_backend(),
+        "wall_s": round(wall_s, 2),
+        "telemetry": telemetry_path,
+        "metrics": metrics,
+    }
+
+
+def cell_main(spec_json: str) -> int:
+    """Subprocess entry: run the cell, print the sentinel result line."""
+    rec = run_cell(json.loads(spec_json))
+    print(f"{CELL_SENTINEL} {json.dumps(rec)}", flush=True)
+    return 0

@@ -1,0 +1,28 @@
+"""Metric extraction: graftscope JSONL -> the gate's flat metric dict.
+
+The matrix runner never times anything itself — every gated number
+comes out of the same telemetry stream production runs emit
+(telemetry/report.py's :func:`~..telemetry.report.metrics_view`), so a
+perf regression visible to the gate is by construction visible to
+observability, and vice versa.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ..telemetry.report import metrics_view, summarize
+
+__all__ = ["extract_metrics", "GATE_METRIC_KEYS"]
+
+# The subset of metrics_view keys the regression gate diffs; the rest
+# ride along in result files as context (docs/BENCHMARKING.md).
+GATE_METRIC_KEYS = (
+    "evals_per_sec", "best_loss", "pareto_volume", "host_fraction",
+    "recompiles",
+)
+
+
+def extract_metrics(events: List[dict]) -> Dict[str, Any]:
+    """Flat per-cell metrics from a validated graftscope event list."""
+    return metrics_view(summarize(events))

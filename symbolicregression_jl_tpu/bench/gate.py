@@ -1,0 +1,408 @@
+"""The regression gate: fresh matrix result vs committed baseline.
+
+A baseline (``benchmarks/baseline.json``, schema
+``graftbench.baseline.v1``) pins per-cell metrics plus per-metric noise
+bands calibrated from repeated seed runs (``bench run --repeats N
+--baseline-out ...``). ``diff_result`` compares a fresh
+``graftbench.result.v1`` record against it:
+
+- **quality** metrics (best_loss, pareto_volume) gate HARD — any
+  regression beyond their (narrow) band fails, whatever the platform.
+  ROADMAP item 3 trades bit-exactness for speed; this is the line it
+  must not cross.
+- **throughput** metrics (evals_per_sec, host_fraction, recompiles)
+  gate at their calibrated band on a DEVICE platform; on CPU only the
+  collapse-floor / blowup-ceiling backstops fail the gate, and band
+  excursions report as non-failing ``soft`` findings — absolute CPU
+  wall-clock does not transfer across hosts (a 2-core CI runner runs
+  the matrix at a fraction of the calibration host's rate with
+  bit-identical quality), and a throughput gate that cries wolf gets
+  deleted.
+- a baseline cell MISSING from the fresh result is a hard failure (a
+  crashing variant must not silently drop out of coverage), as is a
+  schema or matrix-kind mismatch.
+
+Improvements beyond band are reported (so a better baseline gets
+re-pinned) but never fail. Pure host-side JSON — no jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from .matrix import RESULT_SCHEMA
+
+__all__ = [
+    "BASELINE_SCHEMA", "GATED_METRICS", "Band", "Finding",
+    "calibrate_bands", "make_baseline", "load_baseline", "diff_result",
+    "format_findings",
+]
+
+BASELINE_SCHEMA = "graftbench.baseline.v1"
+
+
+@dataclasses.dataclass(frozen=True)
+class Band:
+    """Noise band for one metric. ``direction`` names the REGRESSION
+    direction ("higher" = an increase is bad). A fresh value regresses
+    when it crosses ``base`` by more than rel*|base| + abs in that
+    direction; ``kind`` picks hard (quality) vs CPU-widened
+    (throughput) gating."""
+
+    direction: str  # "higher" | "lower" (which way is worse)
+    kind: str       # "quality" | "throughput"
+    rel: float = 0.0
+    abs: float = 0.0
+
+
+# Default bands — the floor; calibration (repeated seed runs) can only
+# WIDEN them, so a lucky calibration pair can't produce a hair-trigger
+# gate. Quality floors are tight: the search is deterministic given
+# (seed, platform), so best_loss moves only when semantics change.
+GATED_METRICS: Dict[str, Band] = {
+    "best_loss": Band(direction="higher", kind="quality",
+                      rel=0.05, abs=1e-7),
+    "pareto_volume": Band(direction="lower", kind="quality",
+                          rel=0.10, abs=1e-7),
+    "evals_per_sec": Band(direction="lower", kind="throughput",
+                          rel=0.30),
+    "host_fraction": Band(direction="higher", kind="throughput",
+                          rel=0.50, abs=0.10),
+    "recompiles": Band(direction="higher", kind="throughput",
+                       rel=0.25, abs=8),
+}
+
+# CPU wall-clock on shared CI cores is noisy; throughput bands widen by
+# this factor when REPORTING on a CPU result (quality bands never
+# widen). On CPU the band is informational only — see diff_result.
+CPU_THROUGHPUT_BAND_FACTOR = 2.0
+
+# Backstops on EVERY gated metric, any platform and band width (a
+# noisy calibration can push rel past 1.0, where base - margin goes
+# negative and the "lower" band would never fire; an unbounded
+# "higher" margin likewise): a fresh value below COLLAPSE_FLOOR x
+# baseline ("lower is worse" metrics) or above max(BLOWUP_CEILING x
+# baseline, the metric's UN-widened abs band) ("higher is worse") is
+# ALWAYS a regression — a collapse, a quality blow-up, or a recompile
+# storm must not hide inside a wide band. For throughput on CPU these
+# backstops are also the ONLY failing checks (absolute CPU wall-clock
+# does not transfer across hosts; band excursions go "soft").
+COLLAPSE_FLOOR_FRACTION = 0.10
+BLOWUP_CEILING_FACTOR = 10.0
+
+
+@dataclasses.dataclass
+class Finding:
+    cell: str
+    metric: str
+    # regression | soft (CPU throughput excursion, non-failing) |
+    # improvement | ok | missing_cell | schema | note
+    status: str
+    base: Optional[float] = None
+    fresh: Optional[float] = None
+    allowed: Optional[float] = None
+    note: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _spread_band(values: List[float], default: Band) -> Band:
+    """Widen ``default`` to cover the observed spread of repeated runs
+    of the same cell (x2 safety), never narrowing below the floor."""
+    finite = [v for v in values if v is not None]
+    if len(finite) < 2:
+        return default
+    lo, hi = min(finite), max(finite)
+    mid = (lo + hi) / 2.0
+    if mid == 0:
+        return dataclasses.replace(
+            default, abs=max(default.abs, 2.0 * (hi - lo)))
+    rel_spread = (hi - lo) / abs(mid)
+    return dataclasses.replace(
+        default, rel=max(default.rel, 2.0 * rel_spread))
+
+
+def calibrate_bands(results: List[Dict[str, Any]]) -> Dict[str, Band]:
+    """Per-metric noise bands from >=2 repeated matrix runs: for each
+    metric, the widest per-cell spread observed across repeats, floored
+    at the GATED_METRICS defaults."""
+    bands = dict(GATED_METRICS)
+    if len(results) < 2:
+        return bands
+    cell_ids = set().union(*(r.get("cells", {}) for r in results))
+    for metric, default in GATED_METRICS.items():
+        widest = default
+        for cid in cell_ids:
+            vals = [
+                r["cells"][cid]["metrics"].get(metric)
+                for r in results if cid in r.get("cells", {})
+            ]
+            cand = _spread_band(vals, default)
+            if (cand.rel, cand.abs) > (widest.rel, widest.abs):
+                widest = cand
+        bands[metric] = widest
+    return bands
+
+
+def make_baseline(
+    results: List[Dict[str, Any]],
+    bands: Optional[Dict[str, Band]] = None,
+) -> Dict[str, Any]:
+    """Schema-versioned baseline from >=1 matrix runs of the same
+    matrix kind: per-cell metric medians across repeats + bands
+    (calibrated from the repeats unless given)."""
+    if not results:
+        raise ValueError("need at least one matrix result")
+    kinds = {r.get("matrix") for r in results}
+    if len(kinds) != 1:
+        raise ValueError(f"mixed matrix kinds {kinds} cannot baseline")
+    bands = bands or calibrate_bands(results)
+    cell_ids = sorted(set().union(*(r.get("cells", {}) for r in results)))
+    cells: Dict[str, Any] = {}
+    for cid in cell_ids:
+        recs = [r["cells"][cid] for r in results
+                if cid in r.get("cells", {})]
+        metrics: Dict[str, Any] = {}
+        keys = set().union(*(rec["metrics"] for rec in recs))
+        for k in sorted(keys):
+            vals = sorted(
+                rec["metrics"][k] for rec in recs
+                if isinstance(rec["metrics"].get(k), (int, float))
+            )
+            if k in GATED_METRICS and any(
+                    not math.isfinite(v) for v in vals):
+                # a NaN pinned here would permanently fail every later
+                # gate (and json.dump writes NaN without complaint) —
+                # refuse the pin instead
+                raise ValueError(
+                    f"refusing to pin baseline: non-finite {k} in "
+                    f"cell {cid}: {vals}")
+            metrics[k] = vals[len(vals) // 2] if vals else None
+        cells[cid] = {"metrics": metrics,
+                      "variant": recs[0].get("variant"),
+                      "seed": recs[0].get("seed")}
+    from .matrix import library_provenance
+
+    return {
+        "schema": BASELINE_SCHEMA,
+        "matrix": results[0].get("matrix"),
+        "platform": results[0].get("platform"),
+        "created": time.strftime("%Y-%m-%d", time.gmtime()),
+        "provenance": library_provenance(),
+        "repeats": len(results),
+        "cpu_throughput_band_factor": CPU_THROUGHPUT_BAND_FACTOR,
+        "bands": {
+            m: {"direction": b.direction, "kind": b.kind,
+                "rel": b.rel, "abs": b.abs}
+            for m, b in bands.items()
+        },
+        "cells": cells,
+    }
+
+
+def load_baseline(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        baseline = json.load(f)
+    if baseline.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(
+            f"{path}: baseline schema {baseline.get('schema')!r} != "
+            f"{BASELINE_SCHEMA!r} — regenerate it with "
+            "`python -m symbolicregression_jl_tpu.bench run "
+            "--baseline-out <path>`")
+    return baseline
+
+
+def _bands_of(baseline: Dict[str, Any]) -> Dict[str, Band]:
+    bands = {}
+    for m, d in (baseline.get("bands") or {}).items():
+        bands[m] = Band(direction=d["direction"], kind=d["kind"],
+                        rel=float(d.get("rel", 0.0)),
+                        abs=float(d.get("abs", 0.0)))
+    for m, b in GATED_METRICS.items():
+        bands.setdefault(m, b)
+    return bands
+
+
+def diff_result(
+    result: Dict[str, Any], baseline: Dict[str, Any],
+    cells_filter: Optional[List[str]] = None,
+) -> List[Finding]:
+    """All findings from gating ``result`` against ``baseline``; the
+    gate fails iff any finding has status regression/missing_cell/
+    schema (see :func:`gate_failed`).
+
+    ``cells_filter`` restricts the diff to those baseline cell ids (a
+    deliberately sliced dev run — ``gate --variants plain`` — must not
+    hard-fail on every cell it was ASKED not to run); None = all.
+    """
+    findings: List[Finding] = []
+    if result.get("schema") != RESULT_SCHEMA:
+        findings.append(Finding(
+            cell="*", metric="schema", status="schema",
+            note=(f"result schema {result.get('schema')!r} != "
+                  f"{RESULT_SCHEMA!r}")))
+        return findings
+    if baseline.get("schema") != BASELINE_SCHEMA:
+        findings.append(Finding(
+            cell="*", metric="schema", status="schema",
+            note=(f"baseline schema {baseline.get('schema')!r} != "
+                  f"{BASELINE_SCHEMA!r}")))
+        return findings
+    if result.get("matrix") != baseline.get("matrix"):
+        findings.append(Finding(
+            cell="*", metric="matrix", status="schema",
+            note=(f"matrix kind {result.get('matrix')!r} does not match "
+                  f"baseline {baseline.get('matrix')!r}")))
+        return findings
+
+    base_prov = baseline.get("provenance") or {}
+    fresh_prov = result.get("provenance") or {}
+    drifted = [
+        f"{lib} {fresh_prov[lib]} vs baseline's {base_prov[lib]}"
+        for lib in ("jax", "numpy")
+        if base_prov.get(lib) and fresh_prov.get(lib)
+        and base_prov[lib] != fresh_prov[lib]
+    ]
+    if drifted:
+        # a jax/XLA or numpy upgrade can move the chaotic search
+        # trajectory past the hard quality bands: under drift,
+        # quality-band excursions gate SOFT (the backstops stay hard)
+        # so an unpinned dev machine isn't red after every release —
+        # CI pins both libraries to the baseline's provenance, so the
+        # quality gate stays hard where it matters
+        findings.append(Finding(
+            cell="*", metric="provenance", status="note",
+            note=(", ".join(drifted) + " — quality-band excursions "
+                  "gate soft under version drift; re-pin via `bench "
+                  "run --repeats 2 --baseline-out`")))
+
+    bands = _bands_of(baseline)
+    cpu = result.get("platform") == "cpu"
+    cpu_factor = float(baseline.get(
+        "cpu_throughput_band_factor", CPU_THROUGHPUT_BAND_FACTOR))
+    cells = result.get("cells", {})
+    for cid, base_cell in sorted(baseline.get("cells", {}).items()):
+        if cells_filter is not None and cid not in cells_filter:
+            continue
+        fresh_cell = cells.get(cid)
+        if fresh_cell is None:
+            err = (result.get("failures", {}).get(cid) or {}).get("error")
+            findings.append(Finding(
+                cell=cid, metric="*", status="missing_cell",
+                note=err or "cell absent from fresh result"))
+            continue
+        for metric, band in bands.items():
+            base = base_cell["metrics"].get(metric)
+            fresh = fresh_cell["metrics"].get(metric)
+            if base is None:
+                continue
+            if fresh is None:
+                findings.append(Finding(
+                    cell=cid, metric=metric, status="regression",
+                    base=base, note="metric missing from fresh result"))
+                continue
+            if not math.isfinite(fresh) or not math.isfinite(base):
+                # every NaN comparison is False — without this check a
+                # quality collapse to NaN/inf would gate as "ok", and a
+                # NaN pinned into the baseline (json.dump writes it)
+                # would silently disable the metric forever
+                findings.append(Finding(
+                    cell=cid, metric=metric, status="regression",
+                    base=base, fresh=fresh,
+                    note=(f"non-finite value (base={base!r}, "
+                          f"fresh={fresh!r})")))
+                continue
+            widen = (cpu_factor
+                     if cpu and band.kind == "throughput" else 1.0)
+            margin = (band.rel * widen) * abs(base) + band.abs * widen
+            if band.direction == "higher":
+                allowed = base + margin
+                # ceiling floored at the UN-widened abs band (the
+                # headroom near base~0), never the widened margin —
+                # else the ceiling re-opens the hole it plugs
+                allowed = min(allowed,
+                              max(base * BLOWUP_CEILING_FACTOR,
+                                  band.abs))
+                regressed = fresh > allowed
+                improved = fresh < base - margin
+            else:
+                allowed = base - margin
+                if base > 0:
+                    allowed = max(allowed,
+                                  base * COLLAPSE_FLOOR_FRACTION)
+                regressed = fresh < allowed
+                improved = fresh > base + margin
+            status = ("regression" if regressed
+                      else "improvement" if improved else "ok")
+            softenable = (
+                (cpu and band.kind == "throughput")  # wall-clock does
+                # not transfer across hosts
+                or (bool(drifted) and band.kind == "quality")  # the
+                # trajectory legitimately moves across jax/numpy
+                # releases; CI pins versions so this never fires there
+            )
+            if status == "regression" and softenable:
+                # a band excursion is a soft (reported, non-failing)
+                # finding unless it crosses the collapse floor /
+                # blowup ceiling — those backstops always gate hard
+                if band.direction == "lower":
+                    hard = base > 0 and (
+                        fresh < base * COLLAPSE_FLOOR_FRACTION)
+                else:
+                    hard = fresh > max(base * BLOWUP_CEILING_FACTOR,
+                                       band.abs)
+                if not hard:
+                    status = "soft"
+            findings.append(Finding(
+                cell=cid, metric=metric, status=status,
+                base=base, fresh=fresh, allowed=allowed))
+    # fresh cells the baseline doesn't know (a newly added variant)
+    # are UNGATED — surface that, non-failing, so a green gate can't
+    # silently imply coverage the baseline doesn't provide
+    for cid in sorted(set(cells) - set(baseline.get("cells", {}))):
+        findings.append(Finding(
+            cell=cid, metric="*", status="note",
+            note=("cell not in baseline — ungated; re-pin the "
+                  "baseline to cover it")))
+    return findings
+
+
+def gate_failed(findings: List[Finding]) -> bool:
+    return any(f.status in ("regression", "missing_cell", "schema")
+               for f in findings)
+
+
+def format_findings(findings: List[Finding],
+                    verbose: bool = False) -> str:
+    lines: List[str] = []
+    for f in findings:
+        if f.status == "ok" and not verbose:
+            continue
+        if f.status in ("regression", "soft", "improvement", "ok"):
+            lines.append(
+                f"{f.status.upper():<12} {f.cell:<18} {f.metric:<15} "
+                f"base={f.base:.6g} fresh={f.fresh:.6g} "
+                f"allowed={f.allowed:.6g}"
+                if f.fresh is not None and f.allowed is not None else
+                f"{f.status.upper():<12} {f.cell:<18} {f.metric:<15} "
+                f"{f.note}")
+        else:
+            lines.append(
+                f"{f.status.upper():<12} {f.cell:<18} {f.metric:<15} "
+                f"{f.note}")
+    n_reg = sum(f.status == "regression" for f in findings)
+    n_soft = sum(f.status == "soft" for f in findings)
+    n_miss = sum(f.status == "missing_cell" for f in findings)
+    n_imp = sum(f.status == "improvement" for f in findings)
+    n_ok = sum(f.status == "ok" for f in findings)
+    lines.append(
+        f"gate: {n_ok} ok, {n_imp} improved, {n_reg} regressed"
+        + (f", {n_soft} soft (non-failing)" if n_soft else "")
+        + f", {n_miss} missing — "
+        + ("FAIL" if gate_failed(findings) else "PASS"))
+    return "\n".join(lines)

@@ -1,0 +1,187 @@
+"""Serve-level load benchmark: a sustained submit/poll storm against a
+real :class:`~..serve.server.SearchServer`.
+
+Reports the four numbers ROADMAP item 5 asks serve regressions to be
+judged by — requests/s, p99 poll latency, executable-cache hit rate,
+and shed fraction — measured from a live server (workers draining tiny
+deterministic searches), with the cache hit rate read back from the
+server's own graftscope serve stream rather than re-counted here.
+
+The storm deliberately over-submits relative to ``capacity`` so the
+overload ladder engages: sheds and structured rejects are part of the
+measured behavior, not an error. Every submitted request must still
+reach a terminal state (or a structured reject) — anything else fails
+the benchmark.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["LOAD_SCHEMA", "percentile", "run_load"]
+
+LOAD_SCHEMA = "graftbench.load.v1"
+
+
+def percentile(samples: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 100]); None on empty input."""
+    if not samples:
+        return None
+    xs = sorted(samples)
+    k = max(0, min(len(xs) - 1,
+                   int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[k]
+
+
+def _storm_options() -> Dict[str, Any]:
+    # tiny deterministic search: the load bench measures the SERVER
+    # (queueing, journaling, cache, poll responsiveness), not the
+    # search kernel — the matrix cells own that
+    return {
+        "binary_operators": ["+", "*"],
+        "unary_operators": [],
+        "maxsize": 8,
+        "populations": 2,
+        "population_size": 8,
+        "ncycles_per_iteration": 2,
+        "tournament_selection_n": 4,
+        "optimizer_probability": 0.0,
+    }
+
+
+def run_load(
+    root: str,
+    *,
+    requests: int = 10,
+    workers: int = 2,
+    capacity: int = 4,
+    rows: int = 160,
+    niterations: int = 1,
+    poll_interval_s: float = 0.02,
+    timeout_s: float = 600.0,
+    log=print,
+) -> Dict[str, Any]:
+    """Run the storm; returns the schema-versioned load report.
+
+    All requests share one shape bucket (same ``rows``), so repeats
+    after the first SHOULD hit the executable cache — the hit rate is
+    the serve-scaling headline (docs/SERVING.md pins >=90% on repeats).
+    """
+    import numpy as np
+
+    from ..serve.admission import ServerSaturated
+    from ..serve.server import SearchServer
+    from ..telemetry.report import summarize
+    from ..telemetry.schema import load_events
+
+    if os.path.isdir(root):
+        shutil.rmtree(root)  # a stale journal would replay old requests
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2.0, 2.0, (rows, 2)).astype(np.float32)
+    y = (X[:, 0] * 2.0 + X[:, 1]).astype(np.float32)
+    opts = _storm_options()
+
+    server = SearchServer(root, capacity=capacity, workers=workers)
+    submitted: List[str] = []
+    rejects = 0
+    poll_lat: List[float] = []
+    t0 = time.perf_counter()
+    try:
+        server.start()
+        # sustained storm: a rejected submit backs off (bounded by the
+        # server's retry-after hint) and retries — structured rejects
+        # are counted as backpressure events, not lost requests, so the
+        # storm keeps the queue pinned at capacity for its whole span
+        deadline0 = time.monotonic() + timeout_s
+        for i in range(requests):
+            while True:
+                try:
+                    rid = server.submit(
+                        X, y, options=opts, niterations=niterations,
+                        seed=i,
+                    )
+                    submitted.append(rid)
+                    break
+                except ServerSaturated as e:
+                    rejects += 1
+                    if time.monotonic() > deadline0:
+                        break
+                    time.sleep(min(e.retry_after_s or 0.1, 0.25))
+        # sustained poll loop: every poll() call is timed — its latency
+        # is the client-visible responsiveness of the server lock under
+        # concurrent worker/journal traffic
+        pending = set(submitted)
+        deadline = time.monotonic() + timeout_s
+        while pending and time.monotonic() < deadline:
+            for rid in list(pending):
+                tp = time.perf_counter()
+                snap = server.poll(rid)
+                poll_lat.append(time.perf_counter() - tp)
+                if snap["state"] in ("done", "failed", "cancelled"):
+                    pending.discard(rid)
+            time.sleep(poll_interval_s)
+        wall = time.perf_counter() - t0
+    finally:
+        server.stop(drain=False, timeout=30.0)
+
+    snaps = {rid: server.poll(rid) for rid in submitted}
+    done = [r for r, s in snaps.items() if s["state"] == "done"]
+    failed = [r for r, s in snaps.items() if s["state"] == "failed"]
+    unfinished = sorted(set(submitted) - set(done) - set(failed)
+                        - {r for r, s in snaps.items()
+                           if s["state"] == "cancelled"})
+    shed = [r for r, s in snaps.items()
+            if s.get("sample_rows") is not None]
+
+    cache_hit_rate = None
+    serve_stream = os.path.join(root, "serve_telemetry.jsonl")
+    if os.path.exists(serve_stream):
+        summary = summarize(load_events(serve_stream))
+        cache_hit_rate = (summary.get("serve", {})
+                          .get("cache", {}).get("hit_rate"))
+
+    report = {
+        "schema": LOAD_SCHEMA,
+        "t": time.time(),
+        "config": {
+            "requests": requests, "workers": workers,
+            "capacity": capacity, "rows": rows,
+            "niterations": niterations,
+        },
+        "submitted": len(submitted),
+        "rejected": rejects,
+        "completed": len(done),
+        "failed": len(failed),
+        "unfinished": len(unfinished),
+        "shed": len(shed),
+        "shed_fraction": (len(shed) / len(submitted)
+                          if submitted else None),
+        "wall_s": round(wall, 3),
+        "requests_per_sec": (round(len(done) / wall, 3)
+                             if wall > 0 else None),
+        "poll_latency_s": {
+            "samples": len(poll_lat),
+            "p50": percentile(poll_lat, 50),
+            "p99": percentile(poll_lat, 99),
+            "max": max(poll_lat) if poll_lat else None,
+        },
+        "cache_hit_rate": cache_hit_rate,
+        "serve_telemetry": serve_stream,
+    }
+    p99 = report["poll_latency_s"]["p99"]
+    log(f"load: {len(done)}/{len(submitted)} done "
+        f"(+{rejects} rejected, {len(shed)} shed) in {wall:.1f}s — "
+        f"{report['requests_per_sec']} req/s, "
+        f"p99 poll {'-' if p99 is None else format(p99, '.4f')}s, "
+        f"cache hit rate "
+        f"{'-' if cache_hit_rate is None else format(cache_hit_rate, '.0%')}")
+    # a storm where admission wedged and some requests were NEVER
+    # accepted (the retry loop ran out its deadline) must fail too —
+    # submitted==0 with zero failures is not a healthy server
+    report["ok"] = (not failed and not unfinished
+                    and len(submitted) == requests)
+    return report

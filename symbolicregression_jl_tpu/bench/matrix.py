@@ -1,0 +1,176 @@
+"""The fixed benchmark matrix: variants x seeds, one subprocess per cell.
+
+``run_matrix`` executes plain / template / parametric / island-sharded
+cells at 2 seeds each (CPU-sized ``mini`` shapes for CI; chip-sized
+``full`` via ``bench run --full``), telemetry on, and collects per-cell
+metrics from the graftscope JSONL (bench/cell.py + bench/extract.py).
+Results are schema-versioned (``graftbench.result.v1``) so the gate can
+refuse to diff apples against oranges.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .cell import CELL_SENTINEL, FULL, MINI, VARIANTS
+
+__all__ = ["RESULT_SCHEMA", "MATRIX_SHAPES", "library_provenance",
+           "matrix_cells", "run_matrix"]
+
+
+def library_provenance() -> Dict[str, Optional[str]]:
+    """jax/numpy versions behind a result or baseline. Quality bands
+    gate hard, and a jax/XLA upgrade can legitimately move the chaotic
+    search trajectory — the gate surfaces a version mismatch loudly so
+    a red gate right after an upgrade reads as "re-pin the baseline",
+    not as a mystery regression."""
+    versions: Dict[str, Optional[str]] = {}
+    for name in ("jax", "numpy"):
+        try:
+            versions[name] = __import__(name).__version__
+        except Exception:  # noqa: BLE001 - provenance is best-effort
+            versions[name] = None
+    return versions
+
+RESULT_SCHEMA = "graftbench.result.v1"
+
+MATRIX_SHAPES = {"mini": MINI, "full": FULL}
+
+DEFAULT_SEEDS = (0, 1)
+
+# Per-cell subprocess budget: a hung cell must fail the matrix, not
+# wedge CI (mirrors the per-leg dryrun budgets, __graft_entry__.py).
+CELL_TIMEOUT_S = float(os.environ.get("SR_BENCH_CELL_BUDGET", 600))
+
+
+def matrix_cells(
+    variants: Sequence[str] = VARIANTS,
+    seeds: Sequence[int] = DEFAULT_SEEDS,
+) -> List[Tuple[str, str, int]]:
+    """[(cell_id, variant, seed)] for the requested matrix slice."""
+    bad = [v for v in variants if v not in VARIANTS]
+    if bad:
+        raise ValueError(f"unknown variants {bad}; pick from {VARIANTS}")
+    return [(f"{v}/seed{s}", v, s) for v in variants for s in seeds]
+
+
+def _cell_env(variant: str, shape: Dict[str, Any], matrix: str
+              ) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.setdefault("SYMBOLIC_REGRESSION_IS_TESTING", "true")
+    if matrix == "mini":
+        # the CI matrix is a CPU matrix even on a chip host — the gate
+        # baselines are platform-tagged and CPU-calibrated
+        env["JAX_PLATFORMS"] = "cpu"
+    if variant == "sharded":
+        shards = int(shape.get("shards") or 0)
+        if shards > 1:
+            # must be set before the child imports jax; append so a
+            # pre-set XLA_FLAGS keeps its other flags (XLA takes the
+            # last occurrence of a repeated flag — examples/multi_device)
+            flag = f"--xla_force_host_platform_device_count={shards}"
+            if flag not in env.get("XLA_FLAGS", "").split():
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "") + " " + flag).strip()
+    return env
+
+
+def _run_cell_subprocess(
+    cell_id: str, variant: str, seed: int, shape: Dict[str, Any],
+    matrix: str, workdir: str,
+) -> Dict[str, Any]:
+    spec = {
+        "cell_id": cell_id, "variant": variant, "seed": seed,
+        "shape": shape, "out_dir": os.path.join(workdir, "cells"),
+    }
+    cmd = [sys.executable, "-m", "symbolicregression_jl_tpu.bench",
+           "_cell", json.dumps(spec)]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True,
+            env=_cell_env(variant, shape, matrix),
+            timeout=CELL_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return {"cell_id": cell_id, "variant": variant, "seed": seed,
+                "error": f"cell timeout after {CELL_TIMEOUT_S:.0f}s"}
+    wall = time.perf_counter() - t0
+    line = next(
+        (ln for ln in reversed(proc.stdout.splitlines())
+         if ln.startswith(CELL_SENTINEL + " ")), None)
+    if proc.returncode != 0 or line is None:
+        return {
+            "cell_id": cell_id, "variant": variant, "seed": seed,
+            "error": (f"cell exited rc={proc.returncode} without a "
+                      f"result line: {proc.stderr[-500:]}"),
+        }
+    try:
+        rec = json.loads(line[len(CELL_SENTINEL) + 1:])
+    except json.JSONDecodeError as e:
+        # a corrupt sentinel line (interleaved stdout, partial flush)
+        # is that CELL's failure, not the whole matrix run's
+        return {"cell_id": cell_id, "variant": variant, "seed": seed,
+                "error": f"unparseable cell result line: {e}"}
+    rec["subprocess_wall_s"] = round(wall, 2)
+    return rec
+
+
+def run_matrix(
+    *,
+    matrix: str = "mini",
+    variants: Sequence[str] = VARIANTS,
+    seeds: Sequence[int] = DEFAULT_SEEDS,
+    workdir: Optional[str] = None,
+    log=print,
+) -> Dict[str, Any]:
+    """Run the matrix; returns the schema-versioned result record.
+
+    Cells that fail land in ``failures`` (with stderr tails) instead of
+    ``cells`` — the gate treats a baseline cell missing from a fresh
+    result as a hard regression, so a crashing variant cannot silently
+    drop out of coverage.
+    """
+    shape = MATRIX_SHAPES[matrix]
+    workdir = workdir or os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "graftbench")
+    os.makedirs(workdir, exist_ok=True)
+    cells: Dict[str, Any] = {}
+    failures: Dict[str, Any] = {}
+    t0 = time.time()
+    for cell_id, variant, seed in matrix_cells(variants, seeds):
+        rec = _run_cell_subprocess(
+            cell_id, variant, seed, shape, matrix, workdir)
+        if "error" in rec:
+            failures[cell_id] = rec
+            log(f"  {cell_id:<18} FAILED: {rec['error'][:120]}")
+        else:
+            cells[cell_id] = rec
+            m = rec["metrics"]
+            bl = m.get("best_loss")
+            log(f"  {cell_id:<18} "
+                f"evals/s={(m.get('evals_per_sec') or 0):.0f} "
+                f"best_loss={'-' if bl is None else format(bl, '.4g')} "
+                f"recompiles={m.get('recompiles')} "
+                f"({rec['wall_s']:.1f}s)")
+    # platform from what the cells actually ran on (each records
+    # jax.default_backend()), not the matrix kind: a --full run on a
+    # CPU-only host must still get the CPU throughput-band widening
+    backends = {rec.get("backend") for rec in cells.values()}
+    platform = ("cpu" if backends <= {"cpu"} else "device")
+    return {
+        "schema": RESULT_SCHEMA,
+        "matrix": matrix,
+        "platform": platform,
+        "provenance": library_provenance(),
+        "t": time.time(),
+        "wall_s": round(time.time() - t0, 1),
+        "shape": shape,
+        "cells": cells,
+        "failures": failures,
+    }

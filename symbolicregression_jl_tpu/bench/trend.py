@@ -1,0 +1,217 @@
+"""Trajectory report: fold the committed benchmark history into one view.
+
+The driver archives ``python bench.py``'s JSON line as
+``BENCH_r0N.json`` and the multi-chip dryrun as ``MULTICHIP_r0N.json``
+every round; gate runs add ``graftbench.result.v1`` files (CI artifact
++ optional ``benchmarks/history/``). ``bench trend`` folds all three
+into one trajectory so the perf story is read off one report instead of
+hand-diffed artifacts.
+
+A NON-GREEN artifact (nonzero rc, ok=false) is a RED row carrying its
+rc — never silently dropped: MULTICHIP_r05's rc=124 is the motivating
+example (a red dryrun that round 5's narrative only caught because a
+reviewer went digging).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+__all__ = ["build_trend", "format_trend"]
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def _round_of(path: str) -> Optional[int]:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _last_json_line(text: str) -> Optional[Dict[str, Any]]:
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _bench_row(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        art = json.load(f)
+    rc = art.get("rc")
+    row: Dict[str, Any] = {
+        "round": art.get("n", _round_of(path)),
+        "file": os.path.basename(path),
+        "rc": rc,
+        "red": rc not in (0, None),
+    }
+    parsed = art.get("parsed") or _last_json_line(art.get("tail", ""))
+    if parsed and "value" in parsed:
+        row["evals_per_sec"] = parsed.get("value")
+        row["vs_baseline"] = parsed.get("vs_baseline")
+        row["n_devices"] = parsed.get("n_devices")
+        row["projected_v5e8"] = parsed.get("projected_v5e8")
+    elif not row["red"]:
+        # a green rc with an unparseable tail is itself a red flag:
+        # the headline number for that round is unrecoverable
+        row["red"] = True
+        row["note"] = "no parseable bench JSON line in artifact"
+    return row
+
+
+def _multichip_row(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        art = json.load(f)
+    rc = art.get("rc")
+    ok = bool(art.get("ok"))
+    row = {
+        "round": art.get("n", _round_of(path)),
+        "file": os.path.basename(path),
+        "rc": rc,
+        "ok": ok,
+        "skipped": bool(art.get("skipped")),
+        # red = the dryrun RAN and failed; a skip is reported but not
+        # red (no device to run on is not a regression signal)
+        "red": (not ok and not art.get("skipped")),
+        "n_devices": art.get("n_devices"),
+    }
+    if row["red"]:
+        row["note"] = f"dryrun failed rc={rc}"
+    return row
+
+
+def _gate_row(path: str) -> Dict[str, Any]:
+    from .gate import RESULT_SCHEMA
+
+    with open(path) as f:
+        rec = json.load(f)
+    row: Dict[str, Any] = {"file": os.path.basename(path)}
+    if rec.get("schema") != RESULT_SCHEMA:
+        row.update(red=True,
+                   note=f"unexpected schema {rec.get('schema')!r}")
+        return row
+    cells = rec.get("cells", {})
+    failures = rec.get("failures", {})
+    gate = rec.get("gate") or {}
+    gate_failed = bool(gate.get("failed"))
+    eps = [c["metrics"].get("evals_per_sec") for c in cells.values()]
+    eps = [v for v in eps if isinstance(v, (int, float))]
+    row.update(
+        matrix=rec.get("matrix"),
+        platform=rec.get("platform"),
+        cells=len(cells),
+        failed_cells=sorted(failures),
+        # red = cells crashed OR the embedded gate verdict failed — a
+        # band-regression gate run must not render green here
+        red=bool(failures) or gate_failed,
+        mean_evals_per_sec=(
+            round(sum(eps) / len(eps), 1) if eps else None),
+    )
+    notes = []
+    if failures:
+        notes.append(f"{len(failures)} matrix cell(s) failed")
+    if gate_failed:
+        n_reg = sum(1 for f in gate.get("findings", [])
+                    if f.get("status") in ("regression", "missing_cell",
+                                           "schema"))
+        notes.append(f"gate FAILED ({n_reg} finding(s))")
+    if notes:
+        row["note"] = "; ".join(notes)
+    return row
+
+
+def build_trend(
+    root: str = ".",
+    gate_paths: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    """Machine-readable trajectory: every BENCH/MULTICHIP round row
+    (red ones flagged with their rc) + any gate result files found in
+    ``<root>/benchmarks/history/`` or passed explicitly."""
+    bench = sorted(
+        (_bench_row(p) for p in glob.glob(os.path.join(
+            root, "BENCH_r*.json"))),
+        key=lambda r: (r.get("round") or 0))
+    multichip = sorted(
+        (_multichip_row(p) for p in glob.glob(os.path.join(
+            root, "MULTICHIP_r*.json"))),
+        key=lambda r: (r.get("round") or 0))
+    paths = list(gate_paths or [])
+    paths += sorted(glob.glob(os.path.join(
+        root, "benchmarks", "history", "*.json")))
+    gates = [_gate_row(p) for p in paths]
+
+    reds = ([r for r in bench if r["red"]]
+            + [r for r in multichip if r["red"]]
+            + [r for r in gates if r.get("red")])
+    greens = [r for r in bench
+              if not r["red"] and r.get("evals_per_sec") is not None]
+    flat_note = None
+    if len(greens) >= 2:
+        prev, last = greens[-2], greens[-1]
+        if prev["evals_per_sec"]:
+            delta = (last["evals_per_sec"] - prev["evals_per_sec"]
+                     ) / prev["evals_per_sec"]
+            if abs(delta) < 0.05:
+                flat_note = (
+                    f"headline flat r{prev['round']:02d}->"
+                    f"r{last['round']:02d} ({delta:+.1%})")
+    return {
+        "schema": "graftbench.trend.v1",
+        "bench": bench,
+        "multichip": multichip,
+        "gates": gates,
+        "red_count": len(reds),
+        "flat_note": flat_note,
+    }
+
+
+def _fmt(v, spec: str = ",.0f") -> str:
+    return "-" if v is None else format(v, spec)
+
+
+def format_trend(trend: Dict[str, Any]) -> str:
+    lines = ["headline bench (python bench.py, per round):"]
+    for r in trend["bench"]:
+        mark = f"RED rc={r['rc']}" if r["red"] else "ok"
+        lines.append(
+            f"  r{(r.get('round') or 0):02d}  "
+            f"{_fmt(r.get('evals_per_sec')):>12} evals/s  "
+            f"vs_baseline {_fmt(r.get('vs_baseline'), '.2f'):>6}  "
+            f"proj_v5e8 {_fmt(r.get('projected_v5e8')):>12}  [{mark}]"
+            + (f"  {r['note']}" if r.get("note") else ""))
+    lines.append("multi-chip dryrun (MULTICHIP_r0N.json):")
+    for r in trend["multichip"]:
+        if r.get("skipped"):
+            mark = "skipped"
+        elif r["red"]:
+            mark = f"RED rc={r['rc']}"
+        else:
+            mark = "green"
+        lines.append(
+            f"  r{(r.get('round') or 0):02d}  "
+            f"{r.get('n_devices') or '-':>2} device(s)  [{mark}]"
+            + (f"  {r['note']}" if r.get("note") else ""))
+    if trend["gates"]:
+        lines.append("gate matrix results:")
+        for r in trend["gates"]:
+            mark = (f"RED ({r.get('note')})" if r.get("red")
+                    else "green")
+            lines.append(
+                f"  {r['file']:<28} {r.get('matrix') or '?'}/"
+                f"{r.get('platform') or '?'}  "
+                f"cells={r.get('cells', '-')}  "
+                f"mean evals/s {_fmt(r.get('mean_evals_per_sec'))}  "
+                f"[{mark}]")
+    if trend.get("flat_note"):
+        lines.append(f"note: {trend['flat_note']}")
+    lines.append(
+        f"{trend['red_count']} red artifact(s) in the trajectory"
+        if trend["red_count"] else "trajectory is green")
+    return "\n".join(lines)

@@ -21,7 +21,8 @@ from typing import Any, Dict, List, Optional
 
 from .schema import SCHEMA_VERSION, load_events, validate_lines
 
-__all__ = ["summarize", "summarize_requests", "format_report", "main"]
+__all__ = ["summarize", "summarize_requests", "metrics_view",
+           "format_report", "main"]
 
 
 def _mean(xs: List[float]) -> Optional[float]:
@@ -157,6 +158,9 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
             "traces": recompile_traces,
             "backend_compiles": recompile_backend,
             "warm_iterations": len(warm),
+            # the one place the warm rule (post-first event, zero
+            # traces) is computed; metrics_view gates on these ids
+            "warm_iteration_ids": [e["iteration"] for e in warm],
         },
         "transfer_guard_hits": sum(
             e.get("transfer_guard_hits", 0) for e in iters
@@ -249,6 +253,54 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
                 run_end["faults_total"]
             )
     return summary
+
+
+def metrics_view(summary: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten a :func:`summarize` dict to the scalar metrics the
+    graftbench regression gate consumes (docs/BENCHMARKING.md): one
+    number per gated dimension, chosen for per-run stability.
+
+    ``evals_per_sec`` prefers the mean over WARM iterations (no traces,
+    excluding the first event, whose window absorbs compile) and falls
+    back to the peak — on CPU the first-iteration rate is dominated by
+    trace time and would gate on compiler noise, not throughput.
+    """
+    it = summary["iterations"]
+    curve = it["evals_per_sec"]["curve"]
+    # warm ids come from summarize (zero traces, first event excluded
+    # — its window absorbs startup compile even when nothing retraced):
+    # a mid-run retrace's rate must not leak into the gated mean
+    warm_iters = set(it["recompiles"].get("warm_iteration_ids", []))
+    warm_vals = [v for i, v in curve if i in warm_iters]
+    eps = (_mean(warm_vals) if warm_vals
+           else it["evals_per_sec"]["peak"])
+    outputs = summary.get("outputs") or []
+    best_loss = None
+    pareto_volume = None
+    for out in outputs:
+        fl = out.get("final_min_loss")
+        if fl is not None and (best_loss is None or fl > best_loss):
+            best_loss = fl  # worst output gates (multi-output runs)
+        pv = out.get("pareto_volume_curve") or []
+        if pv:
+            v = pv[-1][1]
+            pareto_volume = v if pareto_volume is None else min(
+                pareto_volume, v)
+    end = summary.get("end") or {}
+    return {
+        "evals_per_sec": eps,
+        "evals_per_sec_final": it["evals_per_sec"]["final"],
+        "best_loss": best_loss,
+        "pareto_volume": pareto_volume,
+        "host_fraction": it["host_fraction"]["mean"],
+        "recompiles": it["recompiles"]["traces"],
+        "backend_compiles": it["recompiles"]["backend_compiles"],
+        "warm_iterations": it["recompiles"]["warm_iterations"],
+        "iterations": it["count"],
+        "num_evals": end.get("num_evals"),
+        "elapsed_s": end.get("elapsed_s"),
+        "stop_reason": end.get("stop_reason"),
+    }
 
 
 def _fmt_pct(x: Optional[float]) -> str:
@@ -392,8 +444,9 @@ def format_report(summary: Dict[str, Any]) -> str:
 _USAGE = """usage: python -m symbolicregression_jl_tpu.telemetry <cmd> <run.jsonl>
 
 commands:
-  report <run.jsonl> [--json]   summarize a run (refuses invalid files)
-  validate <run.jsonl>          check every line against graftscope.v1
+  report <run.jsonl> [--json]      summarize a run (refuses invalid files)
+  report <run.jsonl> --metrics     flat gate-metrics JSON (graftbench view)
+  validate <run.jsonl>             check every line against graftscope.v1
 """
 
 
@@ -418,6 +471,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if cmd == "report":
         as_json = "--json" in rest
+        as_metrics = "--metrics" in rest
         paths = [a for a in rest if not a.startswith("-")]
         if len(paths) != 1:
             print(_USAGE, end="", file=sys.stderr)
@@ -428,7 +482,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(str(e), file=sys.stderr)
             return 1
         summary = summarize(events)
-        if as_json:
+        if as_metrics:
+            print(json.dumps(metrics_view(summary)))
+        elif as_json:
             print(json.dumps(summary))
         else:
             print(format_report(summary))

@@ -1,0 +1,663 @@
+"""graftbench host-side units: regression-gate logic over synthetic
+fixtures (docs/BENCHMARKING.md) — baseline diff, noise-band edges,
+missing cells, schema-version mismatch, an injected regression that
+must exit nonzero, band calibration, telemetry metric extraction, the
+trend report's red-artifact flagging, and the load-report percentile.
+
+Pure host-side JSON processing: no search runs here (the real matrix
+is exercised by the tools/check.sh graftbench step and the CI
+bench-gate job).
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+from symbolicregression_jl_tpu.bench import __main__ as bench_cli
+from symbolicregression_jl_tpu.bench.extract import extract_metrics
+from symbolicregression_jl_tpu.bench.gate import (
+    BASELINE_SCHEMA,
+    GATED_METRICS,
+    calibrate_bands,
+    diff_result,
+    gate_failed,
+    load_baseline,
+    make_baseline,
+)
+from symbolicregression_jl_tpu.bench.load import percentile
+from symbolicregression_jl_tpu.bench.matrix import (
+    RESULT_SCHEMA,
+    matrix_cells,
+)
+from symbolicregression_jl_tpu.bench.trend import build_trend, format_trend
+from symbolicregression_jl_tpu.telemetry.schema import validate_lines
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+BASE_METRICS = {
+    "evals_per_sec": 100.0,
+    "best_loss": 0.5,
+    "pareto_volume": 0.2,
+    "host_fraction": 0.01,
+    "recompiles": 700,
+}
+
+
+def synth_result(metrics_by_cell=None, matrix="mini", platform="cpu"):
+    cells = {}
+    for cid, variant, seed in matrix_cells(["plain", "template"], [0, 1]):
+        m = dict(BASE_METRICS)
+        m.update((metrics_by_cell or {}).get(cid, {}))
+        cells[cid] = {"cell_id": cid, "variant": variant, "seed": seed,
+                      "metrics": m}
+    return {"schema": RESULT_SCHEMA, "matrix": matrix,
+            "platform": platform, "cells": cells, "failures": {}}
+
+
+@pytest.fixture()
+def baseline():
+    return make_baseline([synth_result()])
+
+
+# ---------------------------------------------------------------------------
+# gate: pass / regression directions / band edges
+# ---------------------------------------------------------------------------
+
+def test_identical_result_passes(baseline):
+    findings = diff_result(synth_result(), baseline)
+    assert not gate_failed(findings)
+    assert all(f.status == "ok" for f in findings)
+
+
+def test_quality_regression_fails_hard(baseline):
+    # best_loss is direction="higher": a big increase must fail even on
+    # CPU (quality bands never widen with the platform)
+    res = synth_result({"plain/seed0": {"best_loss": 0.7}})
+    findings = diff_result(res, baseline)
+    assert gate_failed(findings)
+    bad = [f for f in findings if f.status == "regression"]
+    assert [(f.cell, f.metric) for f in bad] == [("plain/seed0",
+                                                 "best_loss")]
+
+
+def test_pareto_volume_lower_is_regression(baseline):
+    res = synth_result({"template/seed1": {"pareto_volume": 0.1}})
+    findings = diff_result(res, baseline)
+    assert gate_failed(findings)
+    assert any(f.metric == "pareto_volume" and f.cell == "template/seed1"
+               and f.status == "regression" for f in findings)
+
+
+def test_band_edges_quality():
+    # rel=0.05, abs=1e-7 around best_loss=0.5: 0.525 is the boundary —
+    # just inside passes, just outside fails
+    base = make_baseline([synth_result()])
+    inside = synth_result({"plain/seed0": {"best_loss": 0.525}})
+    assert not gate_failed(diff_result(inside, base))
+    outside = synth_result({"plain/seed0": {"best_loss": 0.5251}})
+    assert gate_failed(diff_result(outside, base))
+
+
+def test_throughput_band_widens_on_cpu(baseline):
+    # evals_per_sec band rel=0.30 x cpu factor 2.0 = 0.60: a 50% drop
+    # passes on CPU but the same result on a device platform fails
+    drop = {"plain/seed0": {"evals_per_sec": 50.0}}
+    assert not gate_failed(diff_result(synth_result(drop), baseline))
+    on_device = synth_result(drop, platform="device")
+    assert gate_failed(diff_result(on_device, baseline))
+
+
+def test_cpu_throughput_excursion_is_soft_not_failing(baseline):
+    # a CPU band excursion above the collapse floor is a SOFT finding
+    # (reported, non-failing): absolute CPU wall-clock does not
+    # transfer across hosts — only the backstops fail a CPU gate
+    res = synth_result({"plain/seed0": {"evals_per_sec": 25.0}})
+    findings = diff_result(res, baseline)
+    assert not gate_failed(findings)
+    soft = [f for f in findings if f.status == "soft"]
+    assert [(f.cell, f.metric) for f in soft] == [("plain/seed0",
+                                                  "evals_per_sec")]
+    from symbolicregression_jl_tpu.bench.gate import format_findings
+
+    assert "soft (non-failing)" in format_findings(findings)
+    # the SAME excursion on a device platform is a hard failure
+    on_device = synth_result({"plain/seed0": {"evals_per_sec": 25.0}},
+                             platform="device")
+    assert gate_failed(diff_result(on_device, baseline))
+
+
+def test_throughput_collapse_fails_even_on_cpu(baseline):
+    res = synth_result({"plain/seed0": {"evals_per_sec": 9.0}})
+    assert gate_failed(diff_result(res, baseline))
+
+
+def test_collapse_floor_survives_vacuous_band(baseline):
+    # a noisy calibration can push the evals/s band past rel=1.0 (base
+    # - margin < 0 — the gate would never fire); the collapse floor
+    # must still catch a fresh value below 10% of baseline
+    wide = copy.deepcopy(baseline)
+    wide["bands"]["evals_per_sec"]["rel"] = 5.0
+    ok = synth_result({"plain/seed0": {"evals_per_sec": 11.0}})
+    assert not gate_failed(diff_result(ok, wide))
+    collapsed = synth_result({"plain/seed0": {"evals_per_sec": 9.0}})
+    findings = diff_result(collapsed, wide)
+    assert gate_failed(findings)
+    assert any(f.metric == "evals_per_sec"
+               and f.status == "regression" for f in findings)
+
+
+def test_quality_backstops_survive_vacuous_band(baseline):
+    # the backstops cover quality too: a calibration-widened quality
+    # band (rel > 1.0) must not disable hard quality gating
+    wide = copy.deepcopy(baseline)
+    wide["bands"]["pareto_volume"]["rel"] = 5.0
+    wide["bands"]["best_loss"]["rel"] = 50.0
+    collapsed = synth_result({"plain/seed0": {"pareto_volume": 0.0}})
+    findings = diff_result(collapsed, wide)
+    assert gate_failed(findings)  # below 10% of base 0.2
+    assert any(f.metric == "pareto_volume"
+               and f.status == "regression" for f in findings)
+    blown = synth_result({"plain/seed0": {"best_loss": 5.1}})
+    assert gate_failed(diff_result(blown, wide))  # above 10x base 0.5
+    assert not gate_failed(diff_result(synth_result(), wide))
+
+
+def test_nan_metric_is_a_regression(baseline):
+    # every NaN comparison is False: without an explicit finiteness
+    # check a quality collapse to NaN would gate as "ok"
+    res = synth_result({"plain/seed0": {"best_loss": float("nan")}})
+    findings = diff_result(res, baseline)
+    assert gate_failed(findings)
+    bad = [f for f in findings if f.status == "regression"]
+    assert bad and "non-finite" in bad[0].note
+    res = synth_result({"plain/seed0": {"evals_per_sec": float("inf")}})
+    assert gate_failed(diff_result(res, baseline))
+
+
+def test_nan_baseline_value_is_a_regression(baseline):
+    # a NaN pinned into the baseline (json.dump writes it) would make
+    # margin NaN and silently disable the metric forever
+    bad_base = copy.deepcopy(baseline)
+    bad_base["cells"]["plain/seed0"]["metrics"]["best_loss"] = float(
+        "nan")
+    findings = diff_result(synth_result(), bad_base)
+    assert gate_failed(findings)
+    assert any("non-finite" in f.note for f in findings
+               if f.status == "regression")
+    # findings must still format without crashing on the None allowed
+    from symbolicregression_jl_tpu.bench.gate import format_findings
+
+    assert "non-finite" in format_findings(findings)
+
+
+def test_blowup_ceiling_survives_vacuous_higher_band(baseline):
+    # the symmetric backstop to the collapse floor: a recompile storm
+    # or host-fraction blow-up beyond 10x baseline must fail even when
+    # a noisy calibration made the band effectively unbounded
+    wide = copy.deepcopy(baseline)
+    wide["bands"]["recompiles"]["rel"] = 50.0
+    wide["bands"]["host_fraction"]["rel"] = 500.0
+    storm = synth_result({"plain/seed0": {"recompiles": 700 * 11}})
+    findings = diff_result(storm, wide)
+    assert gate_failed(findings)
+    assert any(f.metric == "recompiles" and f.status == "regression"
+               for f in findings)
+    hot = synth_result({"plain/seed0": {"host_fraction": 0.9}})
+    assert gate_failed(diff_result(hot, wide))
+    # near-baseline values still pass under the same wide bands
+    assert not gate_failed(diff_result(synth_result(), wide))
+
+
+def test_improvement_is_not_failure(baseline):
+    res = synth_result({"plain/seed0": {"best_loss": 0.1,
+                                        "evals_per_sec": 1000.0}})
+    findings = diff_result(res, baseline)
+    assert not gate_failed(findings)
+    assert any(f.status == "improvement" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# gate: structural failures
+# ---------------------------------------------------------------------------
+
+def test_missing_cell_fails(baseline):
+    res = synth_result()
+    del res["cells"]["template/seed0"]
+    res["failures"]["template/seed0"] = {"error": "cell crashed rc=1"}
+    findings = diff_result(res, baseline)
+    assert gate_failed(findings)
+    miss = [f for f in findings if f.status == "missing_cell"]
+    assert len(miss) == 1 and miss[0].cell == "template/seed0"
+    assert "rc=1" in miss[0].note
+
+
+def test_missing_metric_fails(baseline):
+    res = synth_result()
+    del res["cells"]["plain/seed1"]["metrics"]["best_loss"]
+    assert gate_failed(diff_result(res, baseline))
+
+
+def test_schema_mismatch_fails(baseline):
+    res = synth_result()
+    res["schema"] = "graftbench.result.v999"
+    findings = diff_result(res, baseline)
+    assert gate_failed(findings)
+    assert findings[0].status == "schema"
+
+    bad_base = copy.deepcopy(baseline)
+    bad_base["schema"] = "graftbench.baseline.v999"
+    findings = diff_result(synth_result(), bad_base)
+    assert gate_failed(findings) and findings[0].status == "schema"
+
+
+def test_matrix_kind_mismatch_fails(baseline):
+    res = synth_result(matrix="full", platform="device")
+    findings = diff_result(res, baseline)
+    assert gate_failed(findings)
+    assert findings[0].metric == "matrix"
+
+
+def test_load_baseline_rejects_wrong_schema(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"schema": "graftbench.baseline.v999"}))
+    with pytest.raises(ValueError, match="regenerate"):
+        load_baseline(str(p))
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(make_baseline([synth_result()])))
+    assert load_baseline(str(good))["schema"] == BASELINE_SCHEMA
+
+
+# ---------------------------------------------------------------------------
+# injected regression through the CLI: must exit nonzero
+# ---------------------------------------------------------------------------
+
+def test_injected_regression_exits_nonzero(tmp_path, capsys):
+    base_path = tmp_path / "baseline.json"
+    base_path.write_text(json.dumps(make_baseline([synth_result()])))
+    res_path = tmp_path / "result.json"
+    res_path.write_text(json.dumps(
+        synth_result({"plain/seed0": {"best_loss": 5.0}})))
+    out_path = tmp_path / "gated.json"
+    rc = bench_cli.main([
+        "gate", "--baseline", str(base_path),
+        "--result", str(res_path), "--out", str(out_path)])
+    assert rc == 1
+    assert "FAIL" in capsys.readouterr().out
+    gated = json.loads(out_path.read_text())
+    assert gated["gate"]["failed"] is True
+    assert any(f["status"] == "regression"
+               for f in gated["gate"]["findings"])
+
+
+def test_clean_result_exits_zero(tmp_path, capsys):
+    base_path = tmp_path / "baseline.json"
+    base_path.write_text(json.dumps(make_baseline([synth_result()])))
+    res_path = tmp_path / "result.json"
+    res_path.write_text(json.dumps(synth_result()))
+    rc = bench_cli.main([
+        "gate", "--baseline", str(base_path), "--result", str(res_path)])
+    assert rc == 0
+    assert "PASS" in capsys.readouterr().out
+
+
+def test_gate_result_file_respects_slice_flags(tmp_path, capsys):
+    # gating a precomputed SLICED result with the matching flags must
+    # not hard-fail the deliberately excluded cells
+    base_path = tmp_path / "baseline.json"
+    base_path.write_text(json.dumps(make_baseline([synth_result()])))
+    sliced = synth_result()
+    for cid in list(sliced["cells"]):
+        if not cid.startswith("plain/"):
+            del sliced["cells"][cid]
+    res_path = tmp_path / "sliced.json"
+    res_path.write_text(json.dumps(sliced))
+    rc = bench_cli.main([
+        "gate", "--baseline", str(base_path), "--result", str(res_path),
+        "--variants", "plain"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "PARTIAL" in out and "PASS" in out
+    # without the flags the excluded cells ARE missing — hard fail
+    rc = bench_cli.main([
+        "gate", "--baseline", str(base_path),
+        "--result", str(res_path)])
+    assert rc == 1
+    capsys.readouterr()
+
+
+def test_partial_gate_slices_baseline_cells(baseline):
+    # a sliced dev run diffs only what it ran: the cells it was asked
+    # to skip are not "missing"
+    res = synth_result()
+    for cid in list(res["cells"]):
+        if not cid.startswith("plain/"):
+            del res["cells"][cid]
+    assert gate_failed(diff_result(res, baseline))  # unfiltered: missing
+    findings = diff_result(
+        res, baseline, cells_filter=["plain/seed0", "plain/seed1"])
+    assert not gate_failed(findings)
+    assert {f.cell for f in findings} == {"plain/seed0", "plain/seed1"}
+
+
+def test_fresh_cell_missing_from_baseline_is_noted(baseline):
+    # a newly added variant has no baseline cell: it must not gate
+    # silently green — an ungated-coverage note is emitted
+    res = synth_result()
+    res["cells"]["bf16/seed0"] = {"cell_id": "bf16/seed0",
+                                  "variant": "bf16", "seed": 0,
+                                  "metrics": dict(BASE_METRICS)}
+    findings = diff_result(res, baseline)
+    assert not gate_failed(findings)
+    notes = [f for f in findings if f.status == "note"]
+    assert [f.cell for f in notes] == ["bf16/seed0"]
+    assert "ungated" in notes[0].note
+
+
+def test_provenance_mismatch_is_note_not_failure(baseline):
+    noted = copy.deepcopy(baseline)
+    noted["provenance"] = {"jax": "0.0.1", "numpy": "1.0"}
+    res = synth_result()
+    res["provenance"] = {"jax": "9.9.9", "numpy": "1.0"}
+    findings = diff_result(res, noted)
+    assert not gate_failed(findings)
+    notes = [f for f in findings if f.status == "note"]
+    assert len(notes) == 1 and "re-pin" in notes[0].note
+    from symbolicregression_jl_tpu.bench.gate import format_findings
+
+    assert "9.9.9" in format_findings(findings)
+
+
+def test_quality_excursion_gates_soft_under_version_drift(baseline):
+    # on an unpinned dev machine a jax release legitimately moves the
+    # trajectory: quality band excursions downgrade to soft under
+    # provenance drift (CI pins versions, so there the gate stays
+    # hard) — but the quality BACKSTOPS stay hard even under drift
+    drifted = copy.deepcopy(baseline)
+    drifted["provenance"] = {"jax": "0.0.1", "numpy": "1.0"}
+    res = synth_result({"plain/seed0": {"best_loss": 0.7}})
+    res["provenance"] = {"jax": "9.9.9", "numpy": "1.0"}
+    findings = diff_result(res, drifted)
+    assert not gate_failed(findings)
+    assert any(f.metric == "best_loss" and f.status == "soft"
+               for f in findings)
+    # the same excursion without drift is a hard failure
+    assert gate_failed(diff_result(
+        synth_result({"plain/seed0": {"best_loss": 0.7}}), baseline))
+    # a 10x quality blow-up fails even under drift (backstop)
+    blown = synth_result({"plain/seed0": {"best_loss": 6.0}})
+    blown["provenance"] = {"jax": "9.9.9", "numpy": "1.0"}
+    assert gate_failed(diff_result(blown, drifted))
+
+
+def test_run_refuses_baseline_pin_on_any_repeat_failure(
+        tmp_path, monkeypatch, capsys):
+    from symbolicregression_jl_tpu.bench import matrix as matrix_mod
+
+    results = [synth_result(), synth_result()]
+    del results[0]["cells"]["plain/seed0"]
+    results[0]["failures"]["plain/seed0"] = {"error": "boom"}
+    it = iter(results)
+    monkeypatch.setattr(matrix_mod, "run_matrix",
+                        lambda **kw: next(it))
+    out = tmp_path / "baseline.json"
+    rc = bench_cli.main(["run", "--repeats", "2",
+                         "--baseline-out", str(out)])
+    assert rc == 1
+    assert not out.exists()
+    assert "refusing to pin" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# band calibration from repeated runs
+# ---------------------------------------------------------------------------
+
+def test_calibrate_bands_widens_to_observed_spread():
+    # two repeats with 40% evals/s spread on one cell: the calibrated
+    # band must cover 2x that, above the 0.30 floor
+    r1 = synth_result({"plain/seed0": {"evals_per_sec": 100.0}})
+    r2 = synth_result({"plain/seed0": {"evals_per_sec": 140.0}})
+    bands = calibrate_bands([r1, r2])
+    assert bands["evals_per_sec"].rel > GATED_METRICS[
+        "evals_per_sec"].rel
+    # quality spread of zero keeps the tight floor band
+    assert bands["best_loss"].rel == GATED_METRICS["best_loss"].rel
+
+
+def test_calibrate_bands_never_narrows():
+    bands = calibrate_bands([synth_result(), synth_result()])
+    for m, b in bands.items():
+        assert b.rel >= GATED_METRICS[m].rel
+        assert b.abs >= GATED_METRICS[m].abs
+
+
+def test_make_baseline_refuses_non_finite_gated_metric():
+    # a diverged calibration repeat must fail the pin, not bake a NaN
+    # into the committed baseline (which would fail every later gate)
+    bad = synth_result({"plain/seed0": {"best_loss": float("nan")}})
+    with pytest.raises(ValueError, match="non-finite best_loss"):
+        make_baseline([synth_result(), bad])
+
+
+def test_make_baseline_medians_and_mixed_matrix():
+    r1 = synth_result({"plain/seed0": {"evals_per_sec": 90.0}})
+    r2 = synth_result({"plain/seed0": {"evals_per_sec": 110.0}})
+    r3 = synth_result({"plain/seed0": {"evals_per_sec": 100.0}})
+    base = make_baseline([r1, r2, r3])
+    assert base["cells"]["plain/seed0"]["metrics"][
+        "evals_per_sec"] == 100.0
+    with pytest.raises(ValueError, match="mixed matrix"):
+        make_baseline([synth_result(), synth_result(matrix="full")])
+
+
+# ---------------------------------------------------------------------------
+# metric extraction from (synthetic, schema-valid) graftscope JSONL
+# ---------------------------------------------------------------------------
+
+def _iter_event(i, evals_per_sec, traces, min_loss, pareto_volume):
+    return {
+        "schema": "graftscope.v1", "event": "iteration", "t": 100.0 + i,
+        "iteration": i, "num_evals": 100.0 * i,
+        "evals_per_sec": evals_per_sec, "elapsed_s": 1.0,
+        "device_s": 0.9, "host_s": 0.1, "host_fraction": 0.1,
+        "recompiles": {"traces": traces, "backend_compiles": 0},
+        "transfer_guard_hits": 0,
+        "outputs": [{"output": 1, "min_loss": min_loss,
+                     "pareto_volume": pareto_volume, "counters": None,
+                     "loss_hist": None, "complexity_hist": None}],
+    }
+
+
+def synth_events():
+    events = [
+        {"schema": "graftscope.v1", "event": "run_start", "t": 100.0,
+         "run_id": "cell", "backend": "cpu", "n_devices": 1, "nout": 1,
+         "niterations": 3, "telemetry_interval": 1, "options": {},
+         "engines": []},
+        _iter_event(1, 50.0, 800, 0.9, 0.05),   # cold: traces
+        _iter_event(2, 200.0, 0, 0.6, 0.10),    # warm
+        _iter_event(3, 100.0, 0, 0.5, 0.20),    # warm
+        {"schema": "graftscope.v1", "event": "run_end", "t": 104.0,
+         "stop_reason": "niterations", "iterations": 3,
+         "num_evals": 300.0, "elapsed_s": 3.0,
+         "recompiles_total": {"traces": 800, "backend_compiles": 0}},
+    ]
+    # the fixture must stay schema-valid or extract tests prove nothing
+    assert not validate_lines([json.dumps(e) for e in events])
+    return events
+
+
+def test_extract_metrics_warm_mean_and_quality():
+    m = extract_metrics(synth_events())
+    assert m["evals_per_sec"] == pytest.approx(150.0)  # mean of warm
+    assert m["best_loss"] == pytest.approx(0.5)
+    assert m["pareto_volume"] == pytest.approx(0.20)
+    assert m["recompiles"] == 800
+    assert m["host_fraction"] == pytest.approx(0.1)
+    assert m["num_evals"] == 300.0
+    assert m["stop_reason"] == "niterations"
+
+
+def test_extract_metrics_excludes_midrun_retrace():
+    # a retrace-slowed mid-run iteration (traces > 0) must not leak
+    # into the gated warm mean — only genuinely warm iterations count
+    events = synth_events()
+    events.insert(4, _iter_event(4, 1000.0, 7, 0.5, 0.20))  # retraced
+    m = extract_metrics(events)
+    assert m["evals_per_sec"] == pytest.approx(150.0)  # 200, 100 only
+
+
+def test_extract_metrics_falls_back_to_peak_without_warm():
+    events = synth_events()
+    for e in events:
+        if e["event"] == "iteration":
+            e["recompiles"] = {"traces": 10, "backend_compiles": 0}
+    m = extract_metrics(events)
+    assert m["evals_per_sec"] == pytest.approx(200.0)  # peak fallback
+
+
+def test_report_cli_metrics_flag(tmp_path, capsys):
+    from symbolicregression_jl_tpu.telemetry.report import main as rmain
+
+    p = tmp_path / "run.jsonl"
+    p.write_text("".join(json.dumps(e) + "\n" for e in synth_events()))
+    assert rmain(["report", str(p), "--metrics"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["evals_per_sec"] == pytest.approx(150.0)
+
+
+# ---------------------------------------------------------------------------
+# trend: red artifacts flagged, never dropped
+# ---------------------------------------------------------------------------
+
+def _write(path, payload):
+    with open(path, "w") as f:
+        json.dump(payload, f)
+
+
+def test_trend_marks_red_multichip_with_rc(tmp_path):
+    bench_line = json.dumps({
+        "metric": "full_dataset_expr_evals_per_sec_10k_rows",
+        "value": 507284.7, "unit": "evals/s", "vs_baseline": 7.8})
+    _write(tmp_path / "BENCH_r05.json",
+           {"n": 5, "rc": 0, "tail": "warning noise\n" + bench_line + "\n"})
+    _write(tmp_path / "MULTICHIP_r04.json",
+           {"n_devices": 8, "rc": 0, "ok": True, "skipped": False})
+    _write(tmp_path / "MULTICHIP_r05.json",
+           {"n_devices": 8, "rc": 124, "ok": False, "skipped": False})
+    trend = build_trend(str(tmp_path))
+
+    rows = {r["round"]: r for r in trend["multichip"]}
+    assert rows[4]["red"] is False
+    assert rows[5]["red"] is True and rows[5]["rc"] == 124
+    assert trend["red_count"] == 1
+    assert trend["bench"][0]["evals_per_sec"] == 507284.7
+
+    text = format_trend(trend)
+    assert "RED rc=124" in text
+    assert "r05" in text
+
+
+def test_trend_red_bench_round_not_dropped(tmp_path):
+    _write(tmp_path / "BENCH_r02.json",
+           {"n": 2, "rc": 1, "tail": "Traceback ...\n"})
+    trend = build_trend(str(tmp_path))
+    assert trend["bench"][0]["red"] is True
+    assert trend["bench"][0]["rc"] == 1
+    assert trend["red_count"] == 1
+
+
+def test_trend_unparseable_green_tail_is_red(tmp_path):
+    _write(tmp_path / "BENCH_r03.json",
+           {"n": 3, "rc": 0, "tail": "no json here\n"})
+    trend = build_trend(str(tmp_path))
+    assert trend["bench"][0]["red"] is True
+    assert "no parseable" in trend["bench"][0]["note"]
+
+
+def test_trend_flags_flat_headline(tmp_path):
+    for n, v in ((4, 500000.0), (5, 507000.0)):
+        line = json.dumps({"value": v, "vs_baseline": 7.8})
+        _write(tmp_path / f"BENCH_r0{n}.json",
+               {"n": n, "rc": 0, "tail": line + "\n"})
+    trend = build_trend(str(tmp_path))
+    assert trend["flat_note"] and "r04->r05" in trend["flat_note"]
+
+
+def test_trend_folds_gate_results(tmp_path):
+    hist = tmp_path / "benchmarks" / "history"
+    os.makedirs(hist)
+    _write(hist / "gate_r06.json", synth_result())
+    bad = synth_result()
+    del bad["cells"]["plain/seed0"]
+    bad["failures"]["plain/seed0"] = {"error": "boom"}
+    _write(hist / "gate_r07.json", bad)
+    trend = build_trend(str(tmp_path))
+    assert len(trend["gates"]) == 2
+    green = {g["file"]: g for g in trend["gates"]}
+    assert green["gate_r06.json"]["red"] is False
+    assert green["gate_r07.json"]["red"] is True
+    assert "1 matrix cell(s) failed" in green["gate_r07.json"]["note"]
+
+
+def test_trend_marks_failed_gate_verdict_red(tmp_path):
+    # a gate artifact whose cells all ran but whose embedded verdict
+    # FAILED (band regression) must be a red row, not a green one
+    hist = tmp_path / "benchmarks" / "history"
+    os.makedirs(hist)
+    failed = synth_result()
+    failed["gate"] = {
+        "failed": True,
+        "findings": [{"cell": "plain/seed0", "metric": "best_loss",
+                      "status": "regression"}],
+    }
+    _write(hist / "gate_r08.json", failed)
+    trend = build_trend(str(tmp_path))
+    row = trend["gates"][0]
+    assert row["red"] is True
+    assert "gate FAILED (1 finding(s))" in row["note"]
+    assert trend["red_count"] == 1
+    assert "RED" in format_trend(trend)
+
+
+def test_trend_cli_strict_exit(tmp_path, capsys):
+    _write(tmp_path / "MULTICHIP_r05.json",
+           {"n_devices": 8, "rc": 124, "ok": False, "skipped": False})
+    assert bench_cli.main(["trend", "--root", str(tmp_path)]) == 0
+    assert bench_cli.main(
+        ["trend", "--root", str(tmp_path), "--strict"]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# load report aggregation
+# ---------------------------------------------------------------------------
+
+def test_percentile_nearest_rank():
+    assert percentile([], 99) is None
+    assert percentile([1.0], 99) == 1.0
+    xs = [float(i) for i in range(1, 101)]
+    assert percentile(xs, 50) == pytest.approx(50.0, abs=1.0)
+    assert percentile(xs, 99) == pytest.approx(99.0, abs=1.0)
+    assert percentile(xs, 100) == 100.0
+
+
+# ---------------------------------------------------------------------------
+# projection satellite: the ici-model bridge out of bench.py
+# ---------------------------------------------------------------------------
+
+def test_projection_matches_committed_headline():
+    from symbolicregression_jl_tpu.bench.projection import (
+        v5e8_comm_efficiency,
+    )
+
+    # BENCH_r05's committed projection inputs: 9.77 s/iteration at the
+    # bench config must reproduce the recorded efficiency + byte volume
+    eff, src = v5e8_comm_efficiency(9.77)
+    assert eff == pytest.approx(0.999, abs=5e-4)
+    assert src["total_MB_per_iter_upper"] == pytest.approx(
+        465.349, abs=1e-3)
+    assert src["measured_iter_seconds"] == 9.77

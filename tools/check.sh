@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # Repo check entry point: graftlint static analysis + fast-tier tests
-# + graftscope telemetry schema smoke. CI runs exactly this; run it
-# locally before pushing.
+# + the graftscope/graftshield/graftserve smokes + the graftbench
+# perf/quality regression gate. CI runs exactly this; run it locally
+# before pushing.
 #
-#   tools/check.sh            # lint + fast tests + telemetry smoke
+#   tools/check.sh            # lint + fast tests + smokes + bench gate
 #   tools/check.sh --lint     # lint only (fast, no JAX compile)
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -27,3 +28,12 @@ JAX_PLATFORMS=cpu python tools/fault_smoke.py
 
 echo "== graftserve: kill-restart-replay + overload smoke (docs/SERVING.md) =="
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
+echo "== graftbench: benchmark-matrix gate + serve load smoke (docs/BENCHMARKING.md) =="
+JAX_PLATFORMS=cpu python -m symbolicregression_jl_tpu.bench gate \
+    --baseline benchmarks/baseline.json \
+    --out "${TMPDIR:-/tmp}/graftbench/gate_result.json"
+JAX_PLATFORMS=cpu python -m symbolicregression_jl_tpu.bench load \
+    --requests 8 --workers 2 --capacity 3 \
+    --root "${TMPDIR:-/tmp}/graftbench/load_root" \
+    --out "${TMPDIR:-/tmp}/graftbench/load_result.json"
