@@ -1,0 +1,190 @@
+"""Measured shards-vs-evals/s scaling curve for the graftmesh runtime.
+
+The headline multi-chip number was a closed-form ICI projection for five
+rounds (profiling/ici_model.py; ROADMAP item 1 called it "the single
+biggest credibility gap"). This harness commits a MEASURED curve
+instead: for each shard count it runs the SAME fixed-size search
+(strong scaling — islands constant, islands-per-shard shrinking) on the
+mesh runtime (mesh/MeshEngine: shard_map iteration, explicit
+collectives, per-shard finalize-dedup) and reports warm-iteration
+evals/s plus the cross-shard dedup-key exchange stats.
+
+Each point runs in a SUBPROCESS so the device count is set before jax
+imports (``--xla_force_host_platform_device_count``), exactly like the
+graftbench sharded cells.
+
+CAVEAT for virtual CPU meshes (the default tier, committed as
+profiling/MESH_SCALING.json): the virtual devices SHARE the host's
+cores, so the curve measures that sharded execution works at every
+shard count and what the collectives COST on one core — not speedup.
+Run with ``--full`` on real hardware for the chip-shaped curve the day
+a v5e-8 is attached (same JSON schema; bench trend folds either in).
+
+Usage:
+  python profiling/mesh_scaling.py                  # mini shapes, CPU mesh
+  python profiling/mesh_scaling.py --full           # chip shapes
+  python profiling/mesh_scaling.py --shards 1 2 4   # subset
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+from _common import REPO_ROOT  # noqa: F401 (sys.path setup)
+
+SCHEMA = "graftmesh.scaling.v1"
+POINT_SENTINEL = "MESH_SCALING_POINT"
+
+# mini: sized so the 4-point curve fits a CI-adjacent budget on one CPU
+# core; full: the bench.py headline shapes.
+MINI = dict(rows=512, islands=8, population_size=32, ncycles=8,
+            maxsize=10, tournament_selection_n=8, iterations=2)
+FULL = dict(rows=10_000, islands=512, population_size=256, ncycles=100,
+            maxsize=30, tournament_selection_n=16, iterations=2)
+
+
+def _run_point(shards: int, shape: dict) -> dict:
+    """Child entry: measure one shard count (devices already forced)."""
+    import jax
+
+    from symbolicregression_jl_tpu import Options, search_key
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.mesh import MeshEngine, MeshPlan
+    from symbolicregression_jl_tpu.mesh.dryrun import make_dryrun_problem
+
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["exp", "abs", "cos"],
+        maxsize=int(shape["maxsize"]),
+        populations=int(shape["islands"]),
+        population_size=int(shape["population_size"]),
+        ncycles_per_iteration=int(shape["ncycles"]),
+        tournament_selection_n=int(shape["tournament_selection_n"]),
+        optimizer_probability=0.0,
+        # turbo=True (the committed curve's default): the fused path is
+        # the flagship runtime AND the only dedup-ELIGIBLE one — a
+        # non-turbo curve would measure a path that forfeits the
+        # per-shard dedup the mesh runtime exists to re-enable.
+        turbo=bool(shape.get("turbo", True)),
+        save_to_file=False,
+    )
+    X, y = make_dryrun_problem(int(shape["rows"]))
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+
+    plan = MeshPlan.build(jax.devices()[:shards], n_island_shards=shards)
+    engine = MeshEngine(options, ds.nfeatures, plan)
+    data = plan.place_data(ds.data)
+    state = engine.init_state(search_key(0), data, options.populations)
+    state = plan.place_state(state)
+    # warm (compile) iteration, then the measured ones
+    state = engine.run_iteration(state, data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    ev0 = float(state.num_evals)
+    iters = int(shape["iterations"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = engine.run_iteration(state, data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    dt = time.perf_counter() - t0
+    rate = (float(state.num_evals) - ev0) / dt
+    ex = engine.dedup_exchange(state)
+    return {
+        "shards": shards,
+        "islands": int(shape["islands"]),
+        "evals_per_sec": round(rate, 1),
+        "evals_per_sec_per_shard": round(rate / shards, 1),
+        "iter_seconds": round(dt / iters, 3),
+        "turbo": bool(engine.cfg.turbo),
+        "sharded_dedup": engine._use_dedup(sharded=shards > 1),
+        "dedup_exchange": {
+            k: ex[k] for k in ("rows", "shard_unique", "global_unique",
+                               "cross_shard_dup", "exchanged_bytes")
+        },
+        "backend": jax.default_backend(),
+    }
+
+
+def _spawn_point(shards: int, shape: dict, budget_s: float) -> dict:
+    from symbolicregression_jl_tpu.mesh.dryrun import virtual_cpu_mesh_env
+
+    env = virtual_cpu_mesh_env(shards)
+    env.setdefault("SYMBOLIC_REGRESSION_IS_TESTING", "true")
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--point", str(shards), "--shape-json", json.dumps(shape)]
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        return {"shards": shards,
+                "error": f"point timeout after {budget_s:.0f}s"}
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith(POINT_SENTINEL + " ")), None)
+    if proc.returncode != 0 or line is None:
+        return {"shards": shards,
+                "error": f"rc={proc.returncode}: {proc.stderr[-400:]}"}
+    return json.loads(line[len(POINT_SENTINEL) + 1:])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", nargs="+", type=int, default=[1, 2, 4, 8])
+    ap.add_argument("--full", action="store_true",
+                    help="chip shapes (real hardware)")
+    ap.add_argument("--no-turbo", action="store_true",
+                    help="measure the jnp-interpreter path instead of "
+                         "the fused (dedup-eligible) flagship path")
+    ap.add_argument("--budget", type=float,
+                    default=float(os.environ.get(
+                        "SR_MESH_POINT_BUDGET", "600")))
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--point", type=int, default=None,
+                    help=argparse.SUPPRESS)  # internal child entry
+    ap.add_argument("--shape-json", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.point is not None:
+        shape = json.loads(args.shape_json)
+        rec = _run_point(args.point, shape)
+        print(f"{POINT_SENTINEL} {json.dumps(rec)}", flush=True)
+        return 0
+
+    shape = dict(FULL if args.full else MINI)
+    shape["turbo"] = not args.no_turbo
+    points = []
+    for shards in args.shards:
+        rec = _spawn_point(shards, shape, args.budget)
+        points.append(rec)
+        print(json.dumps(rec), flush=True)
+    import platform
+
+    payload = {
+        "schema": SCHEMA,
+        "matrix": "full" if args.full else "mini",
+        "t": time.time(),
+        "host": {"machine": platform.machine(),
+                 "cpus": os.cpu_count()},
+        "shape": shape,
+        # the virtual-CPU caveat travels WITH the data so trend/readers
+        # can't mistake the one-core curve for scaling efficiency
+        "virtual_cpu_mesh": not args.full,
+        "points": points,
+    }
+    print(json.dumps(payload))
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "MESH_SCALING.json" if not args.full
+        else "MESH_SCALING_full.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    print("wrote", out)
+    return 1 if any("error" in p for p in points) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -162,6 +162,24 @@ def _value_fp(v) -> str:
     if callable(v):
         code = getattr(v, "__code__", None)
         if code is None:
+            # Library callables (jnp.cos, np.abs, math.erf) carry no
+            # Python code object but are process-stable by dotted name —
+            # the same trust the closure guard below extends to module
+            # references. The dotted name must resolve back to THIS
+            # object: instance callables of library wrapper classes
+            # (np.vectorize(lambda ...)) report the library module but
+            # carry per-instance behavior, so they stay opaque.
+            mod = getattr(v, "__module__", None) or ""
+            qn = getattr(v, "__qualname__", None) or getattr(
+                v, "__name__", None)
+            if qn and mod.split(".")[0] in ("jax", "numpy", "math"):
+                import sys
+
+                target = sys.modules.get(mod)
+                for part in qn.split("."):
+                    target = getattr(target, part, None)
+                if target is v:
+                    return f"lib:{mod}.{qn}"
             raise _Unfingerprintable(repr(v))
         # a non-module global read (module-level constant, helper fn)
         # has no process-stable canonical form: it can be rebound

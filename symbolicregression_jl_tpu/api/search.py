@@ -73,6 +73,18 @@ class RuntimeOptions:
     niterations: int = 40
     devices: Optional[Sequence[jax.Device]] = None
     n_data_shards: int = 1
+    # graftmesh (docs/SCALING.md): run the search on the first-class
+    # shard_map island mesh runtime (mesh/MeshEngine) instead of the
+    # legacy GSPMD path. Island-axis sharding only (n_data_shards must
+    # stay 1); re-enables finalize-dedup under sharding (gate it with
+    # ``mesh_dedup`` for A/B — bit-identical either way) and emits
+    # periodic cross-shard dedup-key-exchange ``mesh`` telemetry every
+    # ``mesh_exchange_every`` iterations (0 disables; only when
+    # options.telemetry is on — the exchange is observability only and
+    # never changes the search).
+    mesh_runtime: bool = False
+    mesh_dedup: bool = True
+    mesh_exchange_every: int = 8
     verbosity: int = 1
     progress: bool = False
     run_id: str = dataclasses.field(default_factory=_default_run_id)
@@ -668,6 +680,22 @@ def equation_search(
         n_island_shards=n_island_shards,
         n_data_shards=ropt.n_data_shards,
     )
+    mesh_plan = None
+    if ropt.mesh_runtime:
+        if ropt.n_data_shards != 1:
+            raise ValueError(
+                "mesh_runtime shards the island axis only; data-row "
+                "sharding (n_data_shards > 1) stays on the legacy GSPMD "
+                "path (docs/SCALING.md)"
+            )
+        from ..mesh import MeshPlan
+
+        mesh_plan = MeshPlan(
+            mesh=mesh, n_island_shards=n_island_shards,
+            n_data_shards=ropt.n_data_shards,
+            sharded_dedup=ropt.mesh_dedup,
+            dedup_exchange_every=max(int(ropt.mesh_exchange_every), 0),
+        )
 
     from .. import search_key
 
@@ -716,7 +744,18 @@ def equation_search(
         # instead of re-tracing ~minutes of XLA per request. A None
         # return (no cache, or uncacheable config) builds fresh.
         engine = None
-        if ropt.engine_cache is not None:
+        if mesh_plan is not None:
+            # graftmesh runtime: explicit shard_map plan. Skips the
+            # serve executable cache — its key does not distinguish the
+            # runtimes, and mixing compiled programs across them would
+            # silently serve the wrong executable.
+            from ..mesh import MeshEngine
+
+            engine = MeshEngine(options, ds.nfeatures, mesh_plan,
+                                dtype=_np_dtype(options.eval_dtype),
+                                n_params=n_params, n_classes=n_classes,
+                                template=template)
+        if engine is None and ropt.engine_cache is not None:
             engine = ropt.engine_cache.get_engine(
                 options, nfeatures=ds.nfeatures,
                 dtype=_np_dtype(options.eval_dtype),
@@ -732,7 +771,8 @@ def equation_search(
                             template=template,
                             n_data_shards=ropt.n_data_shards,
                             n_island_shards=n_island_shards, mesh=mesh)
-        data = shard_device_data(ds.data, mesh)
+        data = (mesh_plan.place_data(ds.data) if mesh_plan is not None
+                else shard_device_data(ds.data, mesh))
         key, k_init = jax.random.split(key)
         if saved_state is not None and j < len(saved_state.device_states):
             issues = options.check_warm_start_compatibility(saved_state.options)
@@ -816,7 +856,8 @@ def equation_search(
                     engine, state, trees, data, mode="replace_worst",
                     params=[gp for _, gp in items],
                 )
-        state = shard_search_state(state, mesh)
+        state = (mesh_plan.place_state(state) if mesh_plan is not None
+                 else shard_search_state(state, mesh))
         engines.append(engine)
         states.append(state)
         datas.append(data)
@@ -857,6 +898,7 @@ def equation_search(
                 "n_islands": int(n_islands),
                 "n_island_shards": int(n_island_shards),
                 "nfeatures": int(e.nfeatures),
+                "mesh_runtime": bool(ropt.mesh_runtime),
             }
             for j, e in enumerate(engines)
         ],
@@ -1225,6 +1267,19 @@ def equation_search(
                 host_fraction=monitor.estimate_work_fraction(),
                 events=iter_events,
             ))
+            # graftmesh: periodic cross-shard dedup-key exchange →
+            # ``mesh`` telemetry events. Stream-gated (the exchange is
+            # one small collective; pay it only when someone records
+            # it) and observability-only — it never touches the state,
+            # so the search trajectory is identical with it on or off.
+            if (mesh_plan is not None and hub.path is not None
+                    and mesh_plan.dedup_exchange_every > 0
+                    and it % mesh_plan.dedup_exchange_every == 0):
+                for j, engine in enumerate(engines):
+                    hub.mesh(
+                        iteration=it, shards=mesh_plan.n_island_shards,
+                        output=j + 1, **engine.dedup_exchange(states[j]),
+                    )
             if ropt.verbosity >= 2:
                 print(
                     f"[iter {it}/{ropt.niterations}] "

@@ -36,7 +36,11 @@ FULL = dict(rows=10_000, populations=512,
             population_size=256, ncycles=100, maxsize=30, niterations=3,
             tournament_selection_n=16, shards=0)  # 0 = all devices
 
-VARIANTS = ("plain", "template", "parametric", "sharded")
+# "sharded" = legacy GSPMD island sharding; "sharded-mesh" = the same
+# problem/shapes on the graftmesh shard_map runtime (mesh/MeshEngine,
+# per-shard finalize-dedup, explicit collectives) so mesh perf/quality
+# is gated from day one (docs/SCALING.md).
+VARIANTS = ("plain", "template", "parametric", "sharded", "sharded-mesh")
 
 
 def _problem(shape: Dict[str, Any], variant: str):
@@ -117,10 +121,19 @@ def run_cell(spec: Dict[str, Any]) -> Dict[str, Any]:
     X, y, extra = _problem(shape, variant)
     options = _options(shape, variant, out_dir)
 
+    runtime_options = None
+    if variant == "sharded-mesh":
+        from ..api.search import RuntimeOptions
+
+        runtime_options = RuntimeOptions(
+            niterations=int(shape["niterations"]), mesh_runtime=True,
+        )
+
     t0 = time.perf_counter()
     equation_search(
         X, y, options=options, extra=extra,
         niterations=int(shape["niterations"]),
+        runtime_options=runtime_options,
         verbosity=0, run_id=run_id, seed=seed,
     )
     wall_s = time.perf_counter() - t0

@@ -67,7 +67,7 @@ def _cell_env(variant: str, shape: Dict[str, Any], matrix: str
         # the CI matrix is a CPU matrix even on a chip host — the gate
         # baselines are platform-tagged and CPU-calibrated
         env["JAX_PLATFORMS"] = "cpu"
-    if variant == "sharded":
+    if variant.startswith("sharded"):  # sharded + sharded-mesh
         shards = int(shape.get("shards") or 0)
         if shards > 1:
             # must be set before the child imports jax; append so a

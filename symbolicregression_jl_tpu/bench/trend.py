@@ -127,13 +127,51 @@ def _gate_row(path: str) -> Dict[str, Any]:
     return row
 
 
+def _mesh_scaling_row(path: str) -> Dict[str, Any]:
+    """One row per committed graftmesh scaling artifact
+    (profiling/mesh_scaling.py, schema graftmesh.scaling.v1): the
+    MEASURED shards-vs-evals/s curve that replaces the closed-form ICI
+    projection in the multi-chip story (docs/SCALING.md)."""
+    row: Dict[str, Any] = {"file": os.path.basename(path)}
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        row.update(red=True, note=f"unreadable scaling artifact: {e}")
+        return row
+    if rec.get("schema") != "graftmesh.scaling.v1":
+        row.update(red=True,
+                   note=f"unexpected schema {rec.get('schema')!r}")
+        return row
+    points = rec.get("points") or []
+    errs = [p for p in points if "error" in p]
+    row.update(
+        matrix=rec.get("matrix"),
+        virtual_cpu_mesh=bool(rec.get("virtual_cpu_mesh")),
+        points=[
+            {k: p.get(k) for k in ("shards", "evals_per_sec",
+                                   "evals_per_sec_per_shard")}
+            for p in points if "error" not in p
+        ],
+        red=bool(errs) or not points,
+    )
+    if errs:
+        row["note"] = (f"{len(errs)} scaling point(s) failed: "
+                       + ", ".join(f"shards={p.get('shards')}"
+                                   for p in errs))
+    elif not points:
+        row["note"] = "no measured points in artifact"
+    return row
+
+
 def build_trend(
     root: str = ".",
     gate_paths: Optional[List[str]] = None,
 ) -> Dict[str, Any]:
     """Machine-readable trajectory: every BENCH/MULTICHIP round row
     (red ones flagged with their rc) + any gate result files found in
-    ``<root>/benchmarks/history/`` or passed explicitly."""
+    ``<root>/benchmarks/history/`` or passed explicitly + the measured
+    graftmesh scaling curve(s) under ``<root>/profiling/``."""
     bench = sorted(
         (_bench_row(p) for p in glob.glob(os.path.join(
             root, "BENCH_r*.json"))),
@@ -146,10 +184,15 @@ def build_trend(
     paths += sorted(glob.glob(os.path.join(
         root, "benchmarks", "history", "*.json")))
     gates = [_gate_row(p) for p in paths]
+    mesh_scaling = [
+        _mesh_scaling_row(p) for p in sorted(glob.glob(os.path.join(
+            root, "profiling", "MESH_SCALING*.json")))
+    ]
 
     reds = ([r for r in bench if r["red"]]
             + [r for r in multichip if r["red"]]
-            + [r for r in gates if r.get("red")])
+            + [r for r in gates if r.get("red")]
+            + [r for r in mesh_scaling if r.get("red")])
     greens = [r for r in bench
               if not r["red"] and r.get("evals_per_sec") is not None]
     flat_note = None
@@ -167,6 +210,7 @@ def build_trend(
         "bench": bench,
         "multichip": multichip,
         "gates": gates,
+        "mesh_scaling": mesh_scaling,
         "red_count": len(reds),
         "flat_note": flat_note,
     }
@@ -209,6 +253,20 @@ def format_trend(trend: Dict[str, Any]) -> str:
                 f"cells={r.get('cells', '-')}  "
                 f"mean evals/s {_fmt(r.get('mean_evals_per_sec'))}  "
                 f"[{mark}]")
+    if trend.get("mesh_scaling"):
+        lines.append("measured mesh scaling (profiling/mesh_scaling.py):")
+        for r in trend["mesh_scaling"]:
+            if r.get("red"):
+                lines.append(
+                    f"  {r['file']:<28} [RED]  {r.get('note', '')}")
+                continue
+            curve = "  ".join(
+                f"{p['shards']}sh={_fmt(p.get('evals_per_sec'))}"
+                for p in r.get("points", []))
+            caveat = (" (virtual CPU mesh: one core timeshared — "
+                      "validity+overhead, not speedup)"
+                      if r.get("virtual_cpu_mesh") else "")
+            lines.append(f"  {r['file']:<28} {curve}{caveat}")
     if trend.get("flat_note"):
         lines.append(f"note: {trend['flat_note']}")
     lines.append(

@@ -208,6 +208,58 @@ class Engine:
         return self.cfg.n_classes
 
     # ------------------------------------------------------------------
+    def _dedup_eligible(self) -> bool:
+        """Whether the fused finalize-dedup path exists for this config
+        (plain f32 expressions on the fused kernel; see
+        ops/fused_eval.fused_loss_dedup's caller gates)."""
+        cfg = self.cfg
+        return cfg.turbo and cfg.template is None and cfg.n_params == 0
+
+    def _use_dedup(self, sharded: bool) -> bool:
+        """Finalize-dedup policy hook. The legacy engine forfeits dedup
+        whenever the island axis is sharded (its dup-stats/global view
+        would sort across devices every iteration); mesh.MeshEngine
+        overrides this to run the dedup PER SHARD inside shard_map,
+        which is bit-exact and needs no collective."""
+        del sharded
+        return self._dedup_eligible() and self.n_island_shards == 1
+
+    def _epilogue_draws(self, k_opt, I: int):
+        """The epilogue's host-static optimizer-selection sizing plus
+        its island-major random draws — one definition shared by the
+        legacy and mesh epilogues so the streams can never diverge
+        between runtimes. Returns ``(k_sel, scores, gate, ko2)``."""
+        options = self.options
+        P = self.cfg.population_size
+        k_sel = max(1, round(P * options.optimizer_probability))
+        gate_p = min(P * options.optimizer_probability / k_sel, 1.0)
+        # static options-scalar read, not a traced value
+        opt_kind_on = float(options.mutation_weights.optimize) > 0  # graftlint: disable=GL003
+        if opt_kind_on:
+            # Size the selection to cover the expected number of members
+            # marked by `optimize`-kind mutations this iteration (the
+            # reference runs its optimize branch unconditionally per
+            # draw, src/Mutate.jl:571-658) — marks beyond k_sel slots
+            # would otherwise be dropped.
+            wvec = options.mutation_weights.as_vector()
+            # static host numpy reads of options, not traced values
+            frac_opt = float(options.mutation_weights.optimize) / max(  # graftlint: disable=GL003
+                float(wvec.sum()), 1e-12  # graftlint: disable=GL003
+            )
+            expected = self.cfg.n_slots * self.cfg.ncycles * frac_opt
+            k_sel = max(k_sel, min(P, math.ceil(expected)))
+        do_optimize = options.should_optimize_constants and (
+            options.optimizer_probability > 0 or opt_kind_on
+        )
+        scores = gate = None
+        ko2 = k_opt
+        if do_optimize:
+            ko1, ko2, ko3 = jax.random.split(k_opt, 3)
+            scores = jax.random.uniform(ko1, (I, P))
+            gate = jax.random.bernoulli(ko3, gate_p, (I, k_sel))
+        return k_sel, scores, gate, ko2
+
+    # ------------------------------------------------------------------
     def init_state(self, key, data: DeviceData, n_islands: int,
                    initial_trees: Optional[TreeBatch] = None,
                    initial_params: Optional[jax.Array] = None) -> SearchDeviceState:
@@ -808,37 +860,7 @@ class Engine:
         # All epilogue randomness is drawn here, island-major, so the
         # shard layout cannot change the streams (src/SingleIteration.jl
         # :77-85 per-member coin flips).
-        k_sel = max(1, round(P * options.optimizer_probability))
-        gate_p = min(P * options.optimizer_probability / k_sel, 1.0)
-        # static options-scalar read, not a traced value
-        opt_kind_on = float(options.mutation_weights.optimize) > 0  # graftlint: disable=GL003
-        if opt_kind_on:
-            # Size the selection to cover the expected number of members
-            # marked by `optimize`-kind mutations this iteration (the
-            # reference runs its optimize branch unconditionally per
-            # draw, src/Mutate.jl:571-658) — marks beyond k_sel slots
-            # would otherwise be dropped.
-            wvec = options.mutation_weights.as_vector()
-            # static host numpy reads of options, not traced values
-            frac_opt = float(options.mutation_weights.optimize) / max(  # graftlint: disable=GL003
-                float(wvec.sum()), 1e-12  # graftlint: disable=GL003
-            )
-            import math
-
-            expected = cfg.n_slots * cfg.ncycles * frac_opt
-            k_sel = max(k_sel, min(P, math.ceil(expected)))
-        do_optimize = options.should_optimize_constants and (
-            options.optimizer_probability > 0 or opt_kind_on
-        )
-        scores = gate = None
-        ko2 = k_opt
-        if do_optimize:
-            ko1, ko2, ko3 = jax.random.split(k_opt, 3)
-            scores = jax.random.uniform(ko1, (I, P))
-            gate = jax.random.bernoulli(ko3, gate_p, (I, k_sel))
-
-        use_dedup = (cfg.turbo and cfg.template is None
-                     and cfg.n_params == 0 and self.n_island_shards == 1)
+        k_sel, scores, gate, ko2 = self._epilogue_draws(k_opt, I)
 
         if self._shard_islands:
             isl = lambda tree: jax.tree.map(lambda _: P_(ISLAND_AXIS), tree)
@@ -853,7 +875,8 @@ class Engine:
                      None if batch_idx is None else P_())
             fn = _shard_map(
                 lambda *a: self._island_epilogue(
-                    *a, cfg=cfg, k_sel=k_sel, use_dedup=False,
+                    *a, cfg=cfg, k_sel=k_sel,
+                    use_dedup=self._use_dedup(sharded=True),
                     sharded=True),
                 mesh=self.mesh,
                 in_specs=specs,
@@ -864,8 +887,8 @@ class Engine:
         else:
             pops, ref, f_calls = self._island_epilogue(
                 pops, ref, simp_mark, opt_mark, scores, gate, ko2, data,
-                cur_maxsize, batch_idx, cfg, k_sel, use_dedup,
-                sharded=False)
+                cur_maxsize, batch_idx, cfg, k_sel,
+                self._use_dedup(sharded=False), sharded=False)
         num_evals = num_evals + jnp.sum(f_calls) * eval_fraction
         num_evals = num_evals + I * P  # the finalize re-eval
 

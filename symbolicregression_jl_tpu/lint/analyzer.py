@@ -167,6 +167,14 @@ PALLAS_CALLS = {
     "pl.pallas_call", "pallas_call", "jax.experimental.pallas.pallas_call",
 }
 
+# shard_map entry points: their function argument runs PER DEVICE with
+# named-axis collectives — host-side calls and axis-less collectives
+# inside are hazards (GL008).
+SHARD_MAP_CALLS = {
+    "shard_map", "_shard_map", "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+
 
 def _is_tracer_dotted(dn: Optional[str]) -> bool:
     return dn is not None and dn in TRACER_CALLS
@@ -225,6 +233,7 @@ class ModuleAnalysis:
 
         self.traced: Set[ast.AST] = set()
         self.pallas: Set[ast.AST] = set()
+        self.shardmap: Set[ast.AST] = set()
         self._compute_traced()
 
     # ------------------------------------------------------------------
@@ -269,21 +278,27 @@ class ModuleAnalysis:
                         roots.extend(self._resolve_func_ref(arg))
 
         pallas_roots: List[ast.AST] = []
+        shardmap_roots: List[ast.AST] = []
         for node in ast.walk(self.tree):
-            if (
-                isinstance(node, ast.Call)
-                and dotted_name(node.func) in PALLAS_CALLS
-            ):
-                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            for calls, sink in ((PALLAS_CALLS, pallas_roots),
+                                (SHARD_MAP_CALLS, shardmap_roots)):
+                if dn not in calls:
+                    continue
+                for arg in list(node.args) + [
+                        kw.value for kw in node.keywords]:
                     if isinstance(arg, ast.Lambda):
-                        pallas_roots.append(arg)
+                        sink.append(arg)
                     else:
-                        pallas_roots.extend(self._resolve_func_ref(arg))
+                        sink.extend(self._resolve_func_ref(arg))
 
         # Propagate through module-local calls: anything a traced body
         # calls by simple name or self-attribute is traced too (same
-        # fixpoint for the pallas-kernel set).
-        for seed, out in ((roots, self.traced), (pallas_roots, self.pallas)):
+        # fixpoint for the pallas-kernel and shard_map-body sets).
+        for seed, out in ((roots, self.traced), (pallas_roots, self.pallas),
+                          (shardmap_roots, self.shardmap)):
             work = list(seed)
             while work:
                 fn = work.pop()
@@ -309,6 +324,15 @@ class ModuleAnalysis:
         """Whether ``fn`` is (or is nested inside) a Pallas kernel."""
         while fn is not None:
             if fn in self.pallas:
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    def in_shard_map_body(self, fn: ast.AST) -> bool:
+        """Whether ``fn`` is (or is nested inside / called from) a
+        function passed to ``shard_map`` (module-local fixpoint)."""
+        while fn is not None:
+            if fn in self.shardmap:
                 return True
             fn = self.enclosing_function(fn)
         return False

@@ -733,3 +733,94 @@ def check_signal_unsafe_handler(mod: ModuleAnalysis) -> Iterator[Finding]:
                     f"handlers must only set flags; do the work at the "
                     f"next iteration boundary",
                 )
+
+
+# ---------------------------------------------------------------------------
+# GL008 — host calls / axis-less collectives inside shard_map bodies
+# ---------------------------------------------------------------------------
+
+# Host-side calls that are poison inside a per-device shard_map body:
+# they force a device→host sync (or host I/O) from EVERY shard's
+# program, serializing the mesh (GL003 covers the generic traced-sync
+# cases like float(); this table is the shard_map-specific surface).
+_SMAP_HOST_CALLS = {
+    "jax.device_get", "device_get", "jax.block_until_ready",
+    "block_until_ready",
+    "open", "print",
+    "np.save", "numpy.save", "np.load", "numpy.load",
+    "json.dump", "json.dumps", "pickle.dump", "pickle.dumps",
+}
+_SMAP_HOST_METHODS = {"item", "tolist", "to_py"}
+
+# jax.lax collectives that REQUIRE a named axis inside shard_map; the
+# minimum positional arity that carries it (axis_name is the 2nd
+# positional for all of these except axis_index, where it is the 1st).
+_COLLECTIVE_MIN_ARGS = {
+    "psum": 2, "pmean": 2, "pmax": 2, "pmin": 2,
+    "all_gather": 2, "all_to_all": 2, "ppermute": 2, "pshuffle": 2,
+    "psum_scatter": 2, "axis_index": 1,
+}
+_COLLECTIVE_PREFIXES = ("jax.lax", "lax")
+
+
+@rule(
+    "GL008",
+    "shard-map-hazard",
+    "host-side call or axis-less collective inside a shard_map body",
+    "A shard_map body is one per-device program: a host call inside it "
+    "(`jax.device_get`, `.item()`, file/print I/O) syncs every shard "
+    "through the host and serializes the mesh, and a collective "
+    "without its named axis (`psum(x)` instead of `psum(x, 'island')`) "
+    "either fails to lower or silently reduces over nothing. "
+    "Collectives inside shard_map must name the mesh axis they reduce "
+    "over; host work belongs outside, at the iteration boundary "
+    "(mesh/engine.py is the reference implementation).",
+)
+def check_shard_map_hazard(mod: ModuleAnalysis) -> Iterator[Finding]:
+    if not mod.shardmap:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = mod.enclosing_function(node)
+        if fn is None or not mod.in_shard_map_body(fn):
+            continue
+        dn = dotted_name(node.func)
+        if dn in _SMAP_HOST_CALLS:
+            yield _finding(
+                mod, "GL008", node,
+                f"`{dn}(...)` inside a shard_map body forces a per-"
+                f"shard host sync / host I/O; move it outside the "
+                f"mapped region",
+            )
+            continue
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SMAP_HOST_METHODS
+            and not node.args
+        ):
+            yield _finding(
+                mod, "GL008", node,
+                f"`.{node.func.attr}()` inside a shard_map body forces "
+                f"a per-shard host sync; move it outside the mapped "
+                f"region",
+            )
+            continue
+        if dn is None or "." not in dn:
+            continue
+        prefix, last = dn.rsplit(".", 1)
+        if prefix not in _COLLECTIVE_PREFIXES:
+            continue
+        min_args = _COLLECTIVE_MIN_ARGS.get(last)
+        if min_args is None:
+            continue
+        has_axis = len(node.args) >= min_args or any(
+            kw.arg == "axis_name" for kw in node.keywords
+        )
+        if not has_axis:
+            yield _finding(
+                mod, "GL008", node,
+                f"`{dn}` inside a shard_map body without a named axis — "
+                f"pass the mesh axis it reduces over (e.g. "
+                f"`{dn}(x, 'island')`)",
+            )

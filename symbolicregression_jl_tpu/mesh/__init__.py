@@ -1,0 +1,29 @@
+"""graftmesh — the first-class shard_map island mesh runtime.
+
+The legacy path (``parallel/mesh.py`` + ``evolve/engine.Engine``) leans
+on GSPMD to infer collectives for the cross-island phases and forfeits
+finalize-dedup whenever the island axis is sharded. This package makes
+the execution plan explicit:
+
+- :class:`MeshPlan` — the mesh axes, per-leaf ``PartitionSpec``s for
+  ``SearchDeviceState``/``DeviceData``, donation and dedup-exchange
+  policy, in one inspectable object.
+- :class:`MeshEngine` — an :class:`~..evolve.engine.Engine` whose whole
+  iteration (evolve scan AND epilogue) runs inside ``shard_map`` with
+  explicit collectives: ``all_gather`` for the hall-of-fame merge and
+  the migration pool, ``psum`` for eval counters and running stats, and
+  per-shard finalize-dedup re-enabled (the win the legacy engine
+  forfeits under sharding), plus a periodic all-gather dedup-key
+  exchange emitted as ``graftscope.v1`` ``mesh`` events.
+- :mod:`.aot` — AOT ``jit(...).lower().compile()`` mesh executables
+  with serialization hooks (the serve compile-storm feeder).
+- :mod:`.dryrun` — the fast CI dryrun tier on a virtual CPU mesh (the
+  MULTICHIP artifact producer).
+
+See docs/SCALING.md.
+"""
+
+from .plan import MeshPlan
+from .engine import MeshEngine
+
+__all__ = ["MeshPlan", "MeshEngine"]

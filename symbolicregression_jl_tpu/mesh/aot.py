@@ -1,0 +1,177 @@
+"""AOT mesh executables: ``jit(...).lower().compile()`` + serialization.
+
+The compile storm is the serve layer's cold-start tax (ROADMAP item 2):
+every fresh process pays minutes of XLA for the same iteration program.
+AOT compilation splits trace/lower/compile from dispatch, and — where
+the backend supports it — the compiled executable serializes to bytes,
+so a restarted or horizontally scaled-out replica can load the program
+instead of recompiling it.
+
+``compile_iteration`` lowers the engine's already-donating jitted
+iteration against concrete (state, data) avals and returns a
+:class:`MeshIterationExecutable` whose ``run`` is a pure dispatch — no
+tracing can ever happen on it, which also makes it the deterministic
+core of the mesh scaling harness (profiling/mesh_scaling.py measures
+dispatch-only throughput through it).
+
+Serialization uses ``jax.experimental.serialize_executable`` when
+present (gate with :func:`aot_serialization_supported`); the payload is
+keyed by :func:`aot_cache_key` — the canonical ``options_fingerprint``
+(serve/cache.py's collision rules) plus the mesh/data geometry — so a
+payload can never be dispatched against a mismatched program shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import pickle
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "MeshIterationExecutable",
+    "aot_cache_key",
+    "aot_serialization_supported",
+    "compile_iteration",
+    "load_executable",
+    "save_executable",
+]
+
+_PAYLOAD_VERSION = 1
+
+
+def aot_serialization_supported() -> bool:
+    """Whether this jax build can serialize compiled executables."""
+    try:
+        from jax.experimental.serialize_executable import (  # noqa: F401
+            deserialize_and_load,
+            serialize,
+        )
+    except ImportError:
+        return False
+    return True
+
+
+def aot_cache_key(engine, rows: int) -> Optional[str]:
+    """Executable identity: canonical options fingerprint (None for
+    uncacheable configs — opaque callables etc., same rules as the serve
+    executable cache) + the geometry the program was lowered at."""
+    from ..api.checkpoint import options_fingerprint
+
+    fp = options_fingerprint(engine.options)
+    if fp is None:
+        return None
+    geom = (
+        f"{fp}|nfeat={engine.nfeatures}|rows={int(rows)}"
+        f"|islands={engine.cfg.n_islands * engine.n_island_shards}"
+        f"|shards={engine.n_island_shards}"
+        f"|dtype={jnp.dtype(engine.dtype).name}"
+        f"|backend={jax.default_backend()}|jax={jax.__version__}"
+    )
+    return hashlib.sha256(geom.encode()).hexdigest()
+
+
+@dataclasses.dataclass
+class MeshIterationExecutable:
+    """A compiled (never-retracing) mesh iteration program."""
+
+    compiled: Any               # jax.stages.Compiled
+    cache_key: Optional[str]
+    n_devices: int
+
+    def run(self, state, data, cur_maxsize):
+        """Dispatch one iteration. ``cur_maxsize`` must already be a
+        device int32 scalar (the compiled program has no weak-type
+        coercion); the input state is donated exactly when the engine's
+        jit path donates (MeshPlan.resolve_donation)."""
+        return self.compiled(state, data, cur_maxsize)
+
+    def cost_analysis(self):
+        try:
+            return self.compiled.cost_analysis()
+        except Exception:  # noqa: BLE001 - backend-optional introspection
+            return None
+
+    def memory_analysis(self):
+        try:
+            return self.compiled.memory_analysis()
+        except Exception:  # noqa: BLE001 - backend-optional introspection
+            return None
+
+
+def compile_iteration(engine, state, data, cur_maxsize=None
+                      ) -> MeshIterationExecutable:
+    """AOT-compile the engine's single-launch iteration program against
+    the concrete avals of ``(state, data)``.
+
+    Works for both the legacy Engine and MeshEngine (the jitted
+    ``_iteration`` is the override point); the compiled program bakes in
+    the engine's current launch geometry, so a graftshield degrade
+    (which rebuilds the jits) invalidates it — build a fresh one.
+    """
+    if cur_maxsize is None:
+        cur_maxsize = jnp.int32(engine.cfg.maxsize)
+    elif not isinstance(cur_maxsize, jax.Array):
+        cur_maxsize = jnp.int32(cur_maxsize)
+    lowered = engine._iteration.lower(state, data, cur_maxsize)
+    compiled = lowered.compile()
+    return MeshIterationExecutable(
+        compiled=compiled,
+        cache_key=aot_cache_key(engine, rows=data.y.shape[0]),
+        n_devices=getattr(engine, "n_island_shards", 1),
+    )
+
+
+def save_executable(ex: MeshIterationExecutable, path: str) -> str:
+    """Serialize a compiled iteration to ``path`` (raises RuntimeError
+    when the jax build cannot serialize executables)."""
+    if not aot_serialization_supported():
+        raise RuntimeError(
+            "this jax build cannot serialize compiled executables "
+            "(jax.experimental.serialize_executable missing)")
+    from jax.experimental.serialize_executable import serialize
+
+    payload, in_tree, out_tree = serialize(ex.compiled)
+    blob = pickle.dumps({
+        "version": _PAYLOAD_VERSION,
+        "cache_key": ex.cache_key,
+        "n_devices": ex.n_devices,
+        "payload": payload,
+        "in_tree": in_tree,
+        "out_tree": out_tree,
+    })
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def load_executable(path: str, expect_key: Optional[str] = None
+                    ) -> MeshIterationExecutable:
+    """Load a serialized iteration executable. ``expect_key`` (from
+    :func:`aot_cache_key` on the engine you intend to drive) guards
+    against dispatching a program lowered for a different config,
+    geometry, backend, or jax version."""
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    with open(path, "rb") as f:
+        rec = pickle.load(f)
+    if rec.get("version") != _PAYLOAD_VERSION:
+        raise ValueError(
+            f"{path}: unknown AOT payload version {rec.get('version')!r}")
+    if expect_key is not None and rec.get("cache_key") != expect_key:
+        raise ValueError(
+            f"{path}: executable cache key mismatch (serialized for a "
+            f"different options/geometry/backend) — recompile instead")
+    compiled = deserialize_and_load(
+        rec["payload"], rec["in_tree"], rec["out_tree"])
+    return MeshIterationExecutable(
+        compiled=compiled,
+        cache_key=rec.get("cache_key"),
+        n_devices=int(rec.get("n_devices", 1)),
+    )

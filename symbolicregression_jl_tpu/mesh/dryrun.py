@@ -1,0 +1,569 @@
+"""The mesh dryrun tier: fast, budgeted, artifact-producing.
+
+``python -m symbolicregression_jl_tpu.mesh.dryrun --devices 8 --out f``
+runs the mesh runtime end-to-end on an 8-device mesh — self-provisioning
+a virtual CPU mesh (``--xla_force_host_platform_device_count``) in a
+subprocess when the current process has fewer devices — and writes the
+MULTICHIP-artifact JSON (``n_devices`` / ``rc`` / ``ok`` / ``legs``)
+that ``bench trend`` folds into the trajectory.
+
+Legs (each under a graftshield watchdog budget, SR_DRYRUN_LEG_BUDGET
+seconds, so a compile runaway aborts with a thread dump instead of an
+opaque external rc=124 — the MULTICHIP_r05 failure mode):
+
+- ``mesh-jnp``       — jnp-interpreter iteration inside shard_map over
+  all devices; asserts finite populations, a decodable hall of fame,
+  and cross-shard migration mixing (the explicit all-gather provably
+  moved genomes between shards).
+- ``mesh-turbo-dedup`` — fused (Pallas, interpret off-TPU) kernels
+  inside shard_map WITH per-shard finalize-dedup enabled (the legacy
+  engine forfeits it under sharding), plus a cross-shard dedup-key
+  exchange with its invariants checked.
+- ``mesh-aot``       — AOT ``lower().compile()`` of the mesh iteration,
+  one dispatched iteration through the executable, and (where the
+  backend supports it) a serialize→load round-trip.
+- ``legacy-turbo`` / ``legacy-template`` / ``legacy-datagrid`` — the
+  DEFAULT (mesh_runtime=False) GSPMD runtime's sharded layouts the
+  pre-mesh dryrun covered: plain and template expressions on the fused
+  path under island sharding, and the (island, data) grid whose loss
+  reduction lowers to a psum over the data axis.
+
+This is the CI tier: small shapes, per-leg budgets kept. The measured
+scaling curve lives in profiling/mesh_scaling.py (docs/SCALING.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["make_dryrun_problem", "run_dryrun",
+           "virtual_cpu_mesh_env", "main"]
+
+def _leg_budget_s() -> float:
+    return float(os.environ.get("SR_DRYRUN_LEG_BUDGET", "240"))
+
+
+def _legs(fast: bool):
+    legs = [("mesh-jnp", _leg_jnp)]
+    if not fast:
+        legs += [("mesh-turbo-dedup", _leg_turbo_dedup),
+                 ("mesh-aot", _leg_aot),
+                 ("legacy-turbo", _leg_legacy_turbo),
+                 ("legacy-template", _leg_legacy_template),
+                 ("legacy-datagrid", _leg_legacy_datagrid)]
+    return legs
+
+
+def _total_budget_s(fast: bool) -> float:
+    """Whole-dryrun backstop (subprocess startup included). Derived
+    from the per-leg budget so raising SR_DRYRUN_LEG_BUDGET can never
+    make legally-budgeted legs exceed the total and reproduce the
+    opaque rc=124 this tier exists to eliminate; SR_DRYRUN_BUDGET
+    overrides explicitly."""
+    explicit = float(os.environ.get("SR_DRYRUN_BUDGET", "0"))
+    if explicit > 0:
+        return explicit
+    return max(1800.0, len(_legs(fast)) * _leg_budget_s() + 300.0)
+
+
+def make_dryrun_problem(n_rows: int, nfeatures: int = 5, seed: int = 0):
+    """The bench-family synthetic problem (same formula as bench.py's
+    headline workload) — the ONE copy shared by the dryrun legs,
+    ``__graft_entry__``, and ``profiling/mesh_scaling.py``, so all
+    three tiers measure the same problem."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-3.0, 3.0, (n_rows, nfeatures)).astype(np.float32)
+    y = (
+        np.cos(2.13 * X[:, 0])
+        + 0.5 * X[:, 1] * np.abs(X[:, 2]) ** 0.9
+        - 0.3 * np.abs(X[:, 3]) ** 1.5
+    ).astype(np.float32)
+    return X, y
+
+
+def virtual_cpu_mesh_env(n_devices: int, base_env=None) -> Dict[str, str]:
+    """A child-process env forcing an ``n_devices`` virtual CPU mesh:
+    any existing host-device-count flag is replaced, JAX_PLATFORMS is
+    pinned to cpu. Shared by the dryrun subprocess and the scaling
+    harness (profiling/mesh_scaling.py) so the two can't drift."""
+    env = dict(base_env if base_env is not None else os.environ)
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _options(n_island_shards: int, turbo: bool, expression_spec=None):
+    from ..core.options import Options
+
+    return Options(
+        expression_spec=expression_spec,
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos"],
+        # Shapes sized for the ~5 min driver budget: compile time
+        # dominates this artifact and scales with maxsize (scan depth)
+        # and per-island width; the assertions only need non-trivial
+        # populations (same sizing rationale as the legacy dryrun).
+        maxsize=10,
+        populations=2 * n_island_shards,  # 2 islands per shard
+        population_size=32,
+        ncycles_per_iteration=3,
+        tournament_selection_n=8,
+        optimizer_probability=0.5,
+        optimizer_iterations=2,
+        optimizer_nrestarts=1,
+        # heavy migration so the cross-shard mixing assertion has teeth
+        fraction_replaced=0.3,
+        save_to_file=False,
+        turbo=turbo,
+    )
+
+
+def _build(n_island_shards: int, turbo: bool, sharded_dedup: bool = True):
+    import jax
+
+    from ..core.dataset import make_dataset
+    from .engine import MeshEngine
+    from .plan import MeshPlan
+
+    from .. import search_key
+
+    options = _options(n_island_shards, turbo)
+    X, y = make_dryrun_problem(512)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+    plan = MeshPlan.build(
+        jax.devices()[:n_island_shards], n_island_shards=n_island_shards,
+        sharded_dedup=sharded_dedup,
+    )
+    engine = MeshEngine(options, ds.nfeatures, plan)
+    state = engine.init_state(search_key(0), ds.data, options.populations)
+    state = plan.place_state(state)
+    data = plan.place_data(ds.data)
+    return engine, state, data, options
+
+
+def _check_populations(state, options, template: bool = False) -> None:
+    import numpy as onp
+
+    import jax
+
+    from ..ops.encoding import decode_tree
+
+    cost = onp.asarray(jax.device_get(state.pops.cost))
+    loss = onp.asarray(jax.device_get(state.pops.loss))
+    assert not onp.isnan(cost).any(), "NaN costs after mesh iteration"
+    assert not onp.isnan(loss).any(), "NaN losses after mesh iteration"
+    assert onp.isfinite(cost).mean() > 0.5, (
+        f"only {onp.isfinite(cost).mean():.0%} finite costs"
+    )
+    hof = jax.device_get(state.hof)
+    exists = onp.asarray(hof.exists)
+    assert exists.any(), "hall of fame empty after 2 mesh iterations"
+    for ci in onp.nonzero(exists)[0]:
+        if template:
+            # template members carry a [K, L] key axis: decode each
+            # subexpression row
+            for k in range(onp.asarray(hof.trees.arity).shape[1]):
+                decode_tree(
+                    onp.asarray(hof.trees.arity[ci, k]),
+                    onp.asarray(hof.trees.op[ci, k]),
+                    onp.asarray(hof.trees.feat[ci, k]),
+                    onp.asarray(hof.trees.const[ci, k]),
+                    int(hof.trees.length[ci, k]),
+                    options.operators,
+                )
+            continue
+        tree = decode_tree(
+            onp.asarray(hof.trees.arity[ci]),
+            onp.asarray(hof.trees.op[ci]),
+            onp.asarray(hof.trees.feat[ci]),
+            onp.asarray(hof.trees.const[ci]),
+            int(hof.trees.length[ci]),
+            options.operators,
+        )  # raises on malformed encodings
+        assert tree.count_nodes() == int(hof.trees.length[ci])
+    assert onp.isfinite(
+        onp.asarray(hof.cost)[exists]).all(), "non-finite HoF costs"
+
+
+def _check_migration_mixed(state, options, n_island_shards: int) -> None:
+    """Identical non-trivial trees must appear on islands of DIFFERENT
+    shards after 2 heavy-migration iterations — the explicit pool
+    all-gather provably moved genomes across the mesh."""
+    import numpy as onp
+
+    import jax
+
+    tr = jax.device_get(state.pops.trees)
+    I = options.populations
+    per_shard = I // n_island_shards
+    keys = set()
+    arity, op, feat, length = (
+        onp.asarray(tr.arity), onp.asarray(tr.op), onp.asarray(tr.feat),
+        onp.asarray(tr.length))
+    for i in range(arity.shape[0]):
+        for p in range(arity.shape[1]):
+            ln = int(length[i, p])
+            if ln <= 1:
+                continue  # trivial leaves collide by chance
+            keys.add((
+                i // per_shard,
+                tuple(arity[i, p][:ln].tolist()),
+                tuple(op[i, p][:ln].tolist()),
+                tuple(feat[i, p][:ln].tolist()),
+            ))
+    by_tree: Dict[tuple, set] = {}
+    for shard, *rest in keys:
+        by_tree.setdefault(tuple(rest), set()).add(shard)
+    crossed = sum(1 for s in by_tree.values() if len(s) > 1)
+    assert crossed > 0, (
+        "no identical non-trivial trees shared across island shards — "
+        "mesh migration does not mix across the mesh"
+    )
+
+
+def _leg_jnp(n_devices: int) -> None:
+    import jax
+
+    engine, state, data, options = _build(n_devices, turbo=False)
+    for _ in range(2):
+        state = engine.run_iteration(state, data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    _check_populations(state, options)
+    if n_devices > 1:
+        _check_migration_mixed(state, options, n_devices)
+
+
+def _leg_turbo_dedup(n_devices: int) -> None:
+    import jax
+    import numpy as onp
+
+    engine, state, data, options = _build(n_devices, turbo=True)
+    assert engine.cfg.turbo, "turbo leg must run the fused path"
+    assert engine._use_dedup(sharded=n_devices > 1), (
+        "mesh runtime must keep finalize-dedup enabled under sharding"
+    )
+    for _ in range(2):
+        state = engine.run_iteration(state, data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    _check_populations(state, options)
+    # dedup on/off must be result-NEUTRAL (duplicates copy their group
+    # leader's bit-identical result): rerun the identical search with
+    # sharded_dedup off and compare bit-for-bit
+    engine2, state2, data2, _ = _build(
+        n_devices, turbo=True, sharded_dedup=False)
+    assert not engine2._use_dedup(sharded=n_devices > 1)
+    for _ in range(2):
+        state2 = engine2.run_iteration(state2, data2, options.maxsize)
+    jax.block_until_ready(state2.pops.cost)
+    a = jax.device_get((state.pops, state.hof, state.num_evals))
+    b = jax.device_get((state2.pops, state2.hof, state2.num_evals))
+    for xa, xb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert onp.array_equal(onp.asarray(xa), onp.asarray(xb)), (
+            "sharded finalize-dedup changed the search result"
+        )
+    print("dryrun dedup on/off: bit-identical")
+    ex = engine.dedup_exchange(state)
+    assert ex["global_unique"] <= ex["shard_unique"] <= ex["rows"], ex
+    assert ex["rows"] == options.populations * options.population_size, ex
+    print(f"dryrun dedup exchange: {ex['rows']} rows, "
+          f"{ex['shard_unique']} shard-unique, "
+          f"{ex['global_unique']} global-unique, "
+          f"{ex['exchanged_bytes']} B in {ex['exchange_time_s']:.3f}s")
+
+
+def _run_legacy_runtime(n_devices: int, *, mode: str) -> None:
+    """The DEFAULT (mesh_runtime=False) runtime's sharded layouts the
+    pre-mesh dryrun covered and every user still gets: templates on the
+    fused path under island sharding, and the (island, data) grid whose
+    loss reduction lowers to a psum over the data axis. A regression in
+    the legacy GSPMD runtime must redden the MULTICHIP artifact too.
+    (Three separately-budgeted legs — together they exceed one default
+    leg budget.)"""
+    import jax
+
+    from .. import search_key
+    from ..core.dataset import make_dataset
+    from ..evolve.engine import Engine
+    from ..models import template_spec
+    from ..parallel.mesh import (
+        make_mesh,
+        shard_device_data,
+        shard_search_state,
+    )
+
+    def run_one(n_island_shards: int, n_data_shards: int,
+                turbo: bool, template: bool) -> None:
+        mesh = make_mesh(
+            jax.devices()[: n_island_shards * n_data_shards],
+            n_island_shards=n_island_shards, n_data_shards=n_data_shards)
+        spec = None
+        if template:
+            spec = template_spec(expressions=("f", "g"))(
+                lambda f, g, x1, x2, x3, x4, x5: f(x1, x2) + g(x3))
+        options = _options(n_island_shards, turbo, expression_spec=spec)
+        X, y = make_dryrun_problem(512)
+        ds = make_dataset(X, y)
+        ds.update_baseline_loss(options.elementwise_loss)
+        engine = Engine(options, ds.nfeatures,
+                        n_data_shards=n_data_shards,
+                        n_island_shards=n_island_shards, mesh=mesh,
+                        template=spec.structure if spec else None)
+        if turbo:
+            assert engine.cfg.turbo and engine._shard_islands, (
+                "legacy turbo leg must take the fused shard_map path"
+            )
+        data = shard_device_data(ds.data, mesh)
+        state = engine.init_state(
+            search_key(0), data, options.populations)
+        state = shard_search_state(state, mesh)
+        for _ in range(2):
+            state = engine.run_iteration(state, data, options.maxsize)
+        jax.block_until_ready(state.pops.cost)
+        _check_populations(state, options, template=template)
+
+    if mode == "turbo":
+        # plain expressions on the fused path under island sharding —
+        # the default runtime every mesh_runtime=False TPU user gets
+        run_one(n_devices, 1, turbo=True, template=False)
+    elif mode == "template":
+        # templates on the fused path under island sharding (round-4
+        # verdict item 8: no sharded layout loses the fused path)
+        run_one(n_devices, 1, turbo=True, template=True)
+    elif n_devices >= 4 and n_devices % 2 == 0:
+        # the (island, data) grid on the jnp path: rows sharded over
+        # the data axis, loss reduction -> psum over ICI
+        run_one(n_devices // 2, 2, turbo=False, template=False)
+
+
+def _leg_legacy_turbo(n_devices: int) -> None:
+    _run_legacy_runtime(n_devices, mode="turbo")
+
+
+def _leg_legacy_template(n_devices: int) -> None:
+    _run_legacy_runtime(n_devices, mode="template")
+
+
+def _leg_legacy_datagrid(n_devices: int) -> None:
+    _run_legacy_runtime(n_devices, mode="datagrid")
+
+
+def _leg_aot(n_devices: int) -> None:
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    from .aot import (
+        aot_serialization_supported,
+        compile_iteration,
+        load_executable,
+        save_executable,
+    )
+
+    engine, state, data, options = _build(n_devices, turbo=False)
+    ex = compile_iteration(engine, state, data)
+    out = ex.run(state, data, jnp.int32(options.maxsize))
+    jax.block_until_ready(out.pops.cost)
+    assert not onp.isnan(onp.asarray(jax.device_get(out.pops.cost))).any()
+    if not aot_serialization_supported():
+        print("dryrun aot: serialization unsupported on this jax build; "
+              "compile+dispatch only")
+        return
+    with tempfile.TemporaryDirectory() as d:
+        path = save_executable(ex, os.path.join(d, "iteration.aotx"))
+        ex2 = load_executable(path, expect_key=ex.cache_key)
+        # a fresh state: the executable donates its input
+        engine2, state2, data2, _ = _build(n_devices, turbo=False)
+        del engine2
+        out2 = ex2.run(state2, data2, jnp.int32(options.maxsize))
+        jax.block_until_ready(out2.pops.cost)
+        assert not onp.isnan(
+            onp.asarray(jax.device_get(out2.pops.cost))).any()
+    print(f"dryrun aot: serialize/load round-trip OK "
+          f"(key {ex.cache_key and ex.cache_key[:12]})")
+
+
+def _impl(n_devices: int, fast: bool = False,
+          on_abort=None) -> List[Tuple[str, float]]:
+    """Run the legs in-process (devices must already exist). Returns
+    [(leg, seconds)]; raises (or os._exit via the watchdog) on failure."""
+    import jax
+
+    from ..shield.watchdog import Watchdog
+
+    devices = jax.devices()
+    assert len(devices) >= n_devices, (
+        f"need {n_devices} devices, found {len(devices)}"
+    )
+    assert jax.process_count() == 1, (
+        "the dryrun tier is single-process; multi-host readiness is "
+        "parallel/multihost.py + the same SPMD program (docs/SCALING.md)"
+    )
+
+    leg_budget = _leg_budget_s()
+
+    def abort(dump: str) -> None:
+        sys.stderr.write(dump)
+        sys.stderr.flush()
+        if on_abort is not None:
+            try:
+                on_abort(dump)
+            except Exception:  # the red artifact is best-effort here
+                pass
+        os._exit(3)
+
+    legs = _legs(fast)
+    wd = Watchdog(on_timeout=abort)
+    timings: List[Tuple[str, float]] = []
+    for name, leg in legs:
+        t0 = time.time()
+        with wd.phase(name, leg_budget):
+            leg(n_devices)
+        dt = time.time() - t0
+        timings.append((name, dt))
+        print(f"dryrun leg {name}: {dt:.1f}s (budget {leg_budget:.0f}s)",
+              flush=True)
+    wd.stop()
+    return timings
+
+
+def _child_env(n_devices: int) -> Dict[str, str]:
+    env = virtual_cpu_mesh_env(n_devices)
+    # compile-bound correctness artifact, never a perf measurement:
+    # trade XLA optimization effort for compile time (see
+    # api/search._apply_compile_effort's measurements)
+    env.setdefault("SR_XLA_EFFORT", "-1.0")
+    return env
+
+
+def _write_artifact(path: str, rec: Dict) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+
+
+def run_dryrun(n_devices: int = 8, fast: bool = False,
+               out: Optional[str] = None) -> Dict:
+    """Run the dryrun (subprocess-provisioning a virtual CPU mesh when
+    this process lacks devices) and return the MULTICHIP artifact
+    record. ``out``: artifact path — written by the caller on return,
+    AND by the in-process watchdog abort handler (which os._exits and
+    would otherwise leave a real-hardware timeout with no artifact at
+    all)."""
+    import jax
+
+    rec: Dict = {"n_devices": n_devices, "rc": 0, "ok": True,
+                 "skipped": False, "tail": "", "legs": {}}
+    if len(jax.devices()) >= n_devices:
+        def on_abort(dump: str) -> None:
+            red = dict(rec)
+            red.update(rc=3, ok=False, tail=dump[-2000:])
+            if out:
+                _write_artifact(out, red)
+
+        try:
+            rec["legs"] = dict(
+                _impl(n_devices, fast=fast, on_abort=on_abort))
+        except Exception as e:  # noqa: BLE001 - artifact must record it
+            # (KeyboardInterrupt/SystemExit propagate: an operator's
+            # Ctrl-C must abort, not write a misleading red artifact)
+            rec.update(rc=1, ok=False, tail=f"{type(e).__name__}: {e}")
+        return rec
+
+    cmd = [sys.executable, "-m", "symbolicregression_jl_tpu.mesh.dryrun",
+           "--child", "--devices", str(n_devices)]
+    if fast:
+        cmd.append("--fast")
+    total_budget = _total_budget_s(fast)
+    try:
+        proc = subprocess.run(
+            cmd, env=_child_env(n_devices), capture_output=True, text=True,
+            timeout=total_budget,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+        )
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = 124
+        out = (e.stdout or b"").decode() if isinstance(
+            e.stdout, bytes) else (e.stdout or "")
+        err = f"dryrun subprocess budget {total_budget:.0f}s exceeded"
+    for line in out.splitlines():
+        if line.startswith("dryrun "):
+            print(line, flush=True)
+        if line.startswith("dryrun leg "):
+            try:
+                name = line.split("dryrun leg ", 1)[1].split(":", 1)[0]
+                secs = float(line.split(":", 1)[1].split("s", 1)[0])
+                rec["legs"][name] = secs
+            except (IndexError, ValueError):
+                pass
+    rec.update(
+        rc=rc, ok=(rc == 0),
+        tail=(err[-2000:] if rc != 0 else err[-500:]),
+    )
+    return rec
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m symbolicregression_jl_tpu.mesh.dryrun",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--out", default=None,
+                    help="write the MULTICHIP artifact JSON here")
+    ap.add_argument("--fast", action="store_true",
+                    help="mesh-jnp leg only (the tools/check.sh tier)")
+    ap.add_argument("--child", action="store_true",
+                    help=argparse.SUPPRESS)  # internal subprocess entry
+    args = ap.parse_args(argv)
+
+    if args.child:
+        # Force the virtual CPU mesh before first jax use — some
+        # environments ship a sitecustomize that force-registers an
+        # accelerator platform over JAX_PLATFORMS (same re-pin the
+        # legacy dryrun child does).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from ..api.search import _apply_compile_effort
+
+        try:
+            _apply_compile_effort()
+        except AttributeError:  # jax too old for the effort knob
+            pass
+        _impl(args.devices, fast=args.fast)
+        print(f"mesh dryrun({args.devices}) OK (virtual CPU mesh)")
+        return 0
+
+    rec = run_dryrun(args.devices, fast=args.fast, out=args.out)
+    if args.out:
+        _write_artifact(args.out, rec)
+        print(f"wrote {args.out}")
+    status = "green" if rec["ok"] else f"RED rc={rec['rc']}"
+    print(f"mesh dryrun: {rec['n_devices']} device(s) [{status}]")
+    if not rec["ok"]:
+        sys.stderr.write(rec["tail"] + "\n")
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

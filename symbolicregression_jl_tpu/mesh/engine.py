@@ -1,0 +1,388 @@
+"""MeshEngine: the whole iteration inside shard_map, collectives explicit.
+
+The legacy :class:`~..evolve.engine.Engine` runs only the island-local
+phases under ``shard_map`` (and only on the Pallas path), leaving GSPMD
+to infer the cross-island collectives — and forfeits finalize-dedup
+whenever the island axis is sharded. The mesh runtime makes the plan
+explicit and closes that gap:
+
+- evolve scan AND iteration epilogue run inside ``shard_map`` over the
+  :class:`~.plan.MeshPlan`'s island axis, jnp path included;
+- cross-shard phases use explicit collectives: ``all_gather`` for the
+  hall-of-fame merge inputs and the migration pool, ``psum`` for eval
+  counters and telemetry, ``axis_index`` + ``dynamic_slice`` to carve
+  the shard's islands back out of the (replicated) migrated pool;
+- **sharded finalize-dedup**: each shard dedups its local finalize
+  batch every iteration (exact — duplicates copy their group leader's
+  bit-identical result, ops/fused_eval.fused_loss_dedup), re-enabling
+  the ~1.03–1.15× finalize win the legacy engine forfeits under
+  sharding; a periodic cross-shard **dedup-key exchange**
+  (:meth:`MeshEngine.dedup_exchange`) all-gathers member identity keys
+  to report the residual cross-shard duplication as graftscope ``mesh``
+  events.
+
+Determinism contract: all iteration randomness is drawn island-major
+before the shard boundary (``Engine._epilogue_draws`` — shared with the
+legacy engine), migration's replace/pick draws and pack ranks are
+computed replicated from gathered state, and the 1-shard mesh is
+bit-identical to the legacy engine (tests/test_mesh_engine.py). Under
+>1 shard with the constant optimizer off, the mesh run is bit-identical
+to the unsharded legacy run; with the optimizer on, the fused
+optimizer's restart key is decorrelated per shard exactly like the
+legacy shard_map path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P_
+
+from ..evolve.engine import (
+    Engine,
+    RunningStats,
+    SearchDeviceState,
+    _migrate,
+    _move_window,
+    _shard_map,
+)
+from ..evolve.population import PopulationState
+from ..evolve.step import _member_take_onehot, update_hof
+from ..parallel.mesh import ISLAND_AXIS
+from .plan import MeshPlan
+
+__all__ = ["MeshEngine"]
+
+
+class MeshEngine(Engine):
+    """An Engine whose iteration is an explicit shard_map program over
+    the plan's island mesh axis (see module docstring)."""
+
+    def __init__(self, options, nfeatures, plan: MeshPlan,
+                 dtype=jnp.float32, window_size: int = 100_000,
+                 n_params: int = 0, n_classes: int = 0, template=None):
+        if plan.n_data_shards != 1:
+            raise NotImplementedError(
+                "MeshEngine shards the island axis only; data-row "
+                "sharded layouts stay on the legacy GSPMD path "
+                "(docs/SCALING.md)"
+            )
+        self.plan = plan
+        super().__init__(
+            options, nfeatures, dtype=dtype, window_size=window_size,
+            n_params=n_params, n_classes=n_classes, template=template,
+            n_data_shards=plan.n_data_shards,
+            n_island_shards=plan.n_island_shards, mesh=plan.mesh,
+        )
+        # The mesh runtime always runs island-local phases inside
+        # shard_map — jnp interpreter path included (the legacy engine
+        # only shard_maps the Pallas path and lets GSPMD partition the
+        # rest). Safe to set post-super(): tracing happens at first
+        # dispatch, not at jit construction.
+        self._shard_islands = True
+
+    def _build_jits(self) -> None:
+        super()._build_jits()
+        if not self.plan.resolve_donation():
+            # Rebuild the iteration WITHOUT input-state donation:
+            # XLA:CPU's donated-alias buffers + shard_map collectives
+            # deadlock intermittently on virtual multi-device meshes
+            # (MeshPlan.donate_state documents the observation), and
+            # CPU donation saves nothing. Accelerator backends keep the
+            # legacy donating jit.
+            self._iteration = jax.jit(self._iteration_impl)
+
+    # ------------------------------------------------------------------
+    def _finalize_costs(self, pops, data, cfg, use_dedup):
+        """Keep the dedup toggle ARITHMETIC-neutral: the dedup path
+        finalizes through the materializing loss→cost chain (the
+        in-kernel fused-cost epilogue composes with ``dedup=False``
+        only), and at ragged row counts the two chains differ by ~1 ULP
+        (the epilogue's claimed bit-identity holds at lane-multiple row
+        counts — probed at 48/100 vs 64/128 rows). Without this pin a
+        dedup A/B would compare different arithmetic, not different
+        scheduling. Whenever dedup is ELIGIBLE the mesh finalize uses
+        the materializing chain on or off — which is also exactly what
+        the legacy UNSHARDED engine does (its eligible finalize always
+        takes the dedup branch), preserving 1-shard bit-identity."""
+        if self._dedup_eligible():
+            cfg = cfg._replace(fuse_cost=False)
+        return super()._finalize_costs(pops, data, cfg, use_dedup)
+
+    def _use_dedup(self, sharded: bool) -> bool:
+        """Per-shard finalize-dedup: under shard_map the dedup's sorts
+        run on the shard's LOCAL finalize batch — no collective — so
+        sharding no longer forfeits the win. ``plan.sharded_dedup``
+        gates it for A/B (bit-exact either way)."""
+        if not self._dedup_eligible():
+            return False
+        if not sharded:
+            return True
+        return self.plan.sharded_dedup
+
+    # ------------------------------------------------------------------
+    def _epilogue_part(self, state: SearchDeviceState, data, cur_maxsize,
+                       evolved, key, k_opt, k_mig, batch_idx, cfg):
+        """The mesh iteration epilogue: one shard_map region covering
+        the island-local epilogue AND the cross-island phases, with the
+        collectives written out instead of inferred."""
+        options = self.options
+        I = state.birth.shape[0]          # GLOBAL island count
+        P = cfg.population_size
+        S = self.plan.n_island_shards
+        I_loc = I // S
+        eval_fraction = (
+            cfg.batch_size / data.y.shape[0] if cfg.batching else 1.0
+        )
+
+        if cfg.collect_telemetry:
+            pops, best_seen, nev, birth, ref, marks, tele = evolved
+        else:
+            pops, best_seen, nev, birth, ref, marks = evolved
+            tele = None
+        simp_mark, opt_mark = marks  # [I, P] bools
+
+        # Identical island-major draws as the legacy engine (shared
+        # helper) — the runtime choice cannot change the streams.
+        k_sel, scores, gate, ko2 = self._epilogue_draws(k_opt, I)
+        sharded = S > 1
+        use_dedup = self._use_dedup(sharded=sharded)
+
+        def body(pops, ref, simp_mark, opt_mark, scores, gate, ko2, data,
+                 cur_maxsize, batch_idx, birth, best_seen, nev, tele, hof,
+                 freq, k_mig, num_evals0):
+            # ---- island-LOCAL epilogue on this shard's islands ----
+            pops, ref, f_calls = self._island_epilogue(
+                pops, ref, simp_mark, opt_mark, scores, gate, ko2, data,
+                cur_maxsize, batch_idx, cfg, k_sel, use_dedup,
+                sharded=sharded)
+
+            # ---- explicit collectives ----
+            ag = lambda t: jax.tree.map(
+                lambda x: jax.lax.all_gather(
+                    x, ISLAND_AXIS, axis=0, tiled=True), t)
+            pops_g = ag(pops)          # [I, P, ...] replicated
+            birth_g = jax.lax.all_gather(
+                birth, ISLAND_AXIS, axis=0, tiled=True)
+            best_g = ag(best_seen)
+
+            # Same f32 accumulation chain as the legacy epilogue (the
+            # addends are integer-valued, so the psum split is exact).
+            num_evals = num_evals0 + jax.lax.psum(
+                jnp.sum(nev), ISLAND_AXIS) * eval_fraction
+            num_evals = num_evals + jax.lax.psum(
+                jnp.sum(f_calls), ISLAND_AXIS) * eval_fraction
+            num_evals = num_evals + I * P  # the finalize re-eval
+
+            # ---- hall-of-fame merge (replicated compute on gathered
+            # inputs — bit-identical to the legacy GSPMD merge) ----
+            flat_best = jax.tree.map(
+                lambda x: x.reshape((I * cfg.maxsize,) + x.shape[2:]),
+                best_g)
+            hof = update_hof(
+                hof,
+                PopulationState(
+                    trees=flat_best.trees,
+                    cost=jnp.where(
+                        flat_best.exists, flat_best.cost, jnp.inf),
+                    loss=flat_best.loss,
+                    complexity=flat_best.complexity,
+                    birth=jnp.zeros((I * cfg.maxsize,), jnp.int32),
+                    ref=jnp.zeros((I * cfg.maxsize,), jnp.int32),
+                    parent=jnp.zeros((I * cfg.maxsize,), jnp.int32),
+                    params=flat_best.params,
+                ),
+                cfg.maxsize,
+            )
+            flat_pops = jax.tree.map(
+                lambda x: x.reshape((I * P,) + x.shape[2:]), pops_g)
+            hof = update_hof(hof, flat_pops, cfg.maxsize)
+
+            # ---- migration on the gathered pool: the pool all-gather
+            # is THE cross-shard migration collective; draws and the
+            # binomial pack rank are replicated so every shard computes
+            # the identical migrated population and slices its block ---
+            if options.migration:
+                topn = min(options.topn, P)
+                order = jnp.argsort(pops_g.cost, axis=1)[:, :topn]
+                pool = jax.vmap(
+                    lambda p, o: _member_take_onehot(p, o, P)
+                )(pops_g, order)
+                pool = jax.tree.map(
+                    lambda x: x.reshape((I * topn,) + x.shape[2:]), pool)
+                pool_ok = jnp.isfinite(pool.cost)
+                km1, km2, km3, km4 = jax.random.split(k_mig, 4)
+                pops_g, birth_g = _migrate(
+                    km1, pops_g, pool, options.fraction_replaced,
+                    birth_g, I, P, candidate_mask=pool_ok)
+                if options.hof_migration:
+                    hof_pool = PopulationState(
+                        trees=hof.trees,
+                        cost=jnp.where(hof.exists, hof.cost, jnp.inf),
+                        loss=hof.loss,
+                        complexity=hof.complexity,
+                        birth=jnp.zeros((cfg.maxsize,), jnp.int32),
+                        ref=jnp.zeros((cfg.maxsize,), jnp.int32),
+                        parent=jnp.zeros((cfg.maxsize,), jnp.int32),
+                        params=hof.params,
+                    )
+                    pops_g, birth_g = _migrate(
+                        km2, pops_g, hof_pool,
+                        options.fraction_replaced_hof, birth_g, I, P,
+                        candidate_mask=hof.exists)
+
+            # ---- running stats on the global populations ----
+            sizes = pops_g.complexity.reshape(-1)
+            in_range = (sizes > 0) & (sizes <= cfg.maxsize)
+            hist = jnp.zeros((cfg.maxsize,), jnp.float32).at[
+                jnp.where(in_range, sizes - 1, 0)
+            ].add(in_range.astype(jnp.float32))
+            new_freq = _move_window(
+                freq + hist, self.window_size, cfg.maxsize)
+            stats = RunningStats(
+                frequencies=new_freq,
+                normalized_frequencies=new_freq / jnp.sum(new_freq),
+            )
+
+            # ---- carve this shard's islands back out ----
+            shard = jax.lax.axis_index(ISLAND_AXIS)
+            start = shard * jnp.int32(I_loc)
+            sl = lambda x: jax.lax.dynamic_slice_in_dim(
+                x, start, I_loc, axis=0)
+            pops_l = jax.tree.map(sl, pops_g)
+            birth_l = sl(birth_g)
+
+            telem = None
+            if cfg.collect_telemetry:
+                from ..telemetry.counters import (
+                    IterationTelemetry,
+                    loss_histogram,
+                    member_dup_stats,
+                )
+
+                cyc = jax.tree.map(
+                    lambda x: jax.lax.psum(
+                        jnp.sum(x, axis=0), ISLAND_AXIS), tele)
+                cyc = dataclasses.replace(
+                    cyc,
+                    eval_rows=cyc.eval_rows + jnp.int32(I * P),
+                    eval_launches=cyc.eval_launches + jnp.int32(1),
+                )
+                # Per-shard dup stats, psum'd over shards: exactly the
+                # duplication per-shard dedup exploits (the legacy
+                # engine reports zeros here under sharding; at 1 shard
+                # this equals its global stats bit-for-bit).
+                fr, fu = member_dup_stats(pops_l.trees)
+                telem = IterationTelemetry(
+                    cycle=cyc,
+                    finalize_rows=jax.lax.psum(fr, ISLAND_AXIS),
+                    finalize_unique=jax.lax.psum(fu, ISLAND_AXIS),
+                    loss_hist=loss_histogram(pops_g.loss),
+                    cx_hist=hist.astype(jnp.int32),
+                )
+            out = (pops_l, birth_l, ref, hof, stats, num_evals)
+            if cfg.collect_telemetry:
+                out = out + (telem,)
+            return out
+
+        isl = lambda t: jax.tree.map(lambda _: P_(ISLAND_AXIS), t)
+        rep = lambda t: jax.tree.map(lambda _: P_(), t)
+        args = (pops, ref, simp_mark, opt_mark, scores, gate, ko2, data,
+                cur_maxsize, batch_idx, birth, best_seen, nev, tele,
+                state.hof, state.stats.frequencies, k_mig,
+                state.num_evals)
+        in_specs = (
+            isl(pops), P_(ISLAND_AXIS), P_(ISLAND_AXIS), P_(ISLAND_AXIS),
+            None if scores is None else P_(ISLAND_AXIS),
+            None if gate is None else P_(ISLAND_AXIS),
+            rep(ko2), rep(data), P_(),
+            None if batch_idx is None else P_(),
+            P_(ISLAND_AXIS), isl(best_seen), P_(ISLAND_AXIS),
+            None if tele is None else isl(tele),
+            rep(state.hof), P_(), rep(k_mig), P_(),
+        )
+        out_specs = (
+            isl(pops), P_(ISLAND_AXIS), P_(ISLAND_AXIS),
+            rep(state.hof), rep(state.stats), P_(),
+        )
+        if cfg.collect_telemetry:
+            out_specs = out_specs + (rep(state.telem),)
+        out = _shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)(*args)
+        if cfg.collect_telemetry:
+            pops_l, birth_l, ref_l, hof, stats, num_evals, telem = out
+        else:
+            pops_l, birth_l, ref_l, hof, stats, num_evals = out
+            telem = None
+        return SearchDeviceState(
+            pops=pops_l, hof=hof, stats=stats, birth=birth_l, ref=ref_l,
+            num_evals=num_evals, key=key, telem=telem,
+        )
+
+    # ------------------------------------------------------------------
+    def dedup_exchange(self, state: SearchDeviceState) -> Dict[str, Any]:
+        """The periodic cross-shard dedup-key exchange (observability
+        only — never touches the search state): all-gathers the members'
+        identity hash keys (telemetry/counters.member_hash_keys, the
+        same keys the dup-stats counter uses) over the island axis and
+        reports the duplication split — local to a shard (per-shard
+        dedup already exploits it) vs visible only globally (migration
+        copies on other shards). One tiny jitted collective, driven by
+        the host loop every ``plan.dedup_exchange_every`` iterations;
+        the result feeds the graftscope ``mesh`` event."""
+        if not hasattr(self, "_exchange_jit"):
+            from ..telemetry.counters import (
+                member_hash_keys,
+                unique_key_count,
+            )
+
+            def exchange(trees):
+                def ex_body(tr):
+                    keys = member_hash_keys(tr)       # 3 x [N_local]
+                    local_unique = unique_key_count(keys)
+                    gathered = [
+                        jax.lax.all_gather(k, ISLAND_AXIS, tiled=True)
+                        for k in keys
+                    ]
+                    global_unique = unique_key_count(gathered)
+                    shard_unique_sum = jax.lax.psum(
+                        local_unique, ISLAND_AXIS)
+                    per_shard = jax.lax.all_gather(
+                        local_unique, ISLAND_AXIS)
+                    return (jnp.int32(gathered[0].shape[0]),
+                            shard_unique_sum, global_unique, per_shard)
+
+                specs = jax.tree.map(lambda _: P_(ISLAND_AXIS), trees)
+                return _shard_map(
+                    ex_body, mesh=self.mesh, in_specs=(specs,),
+                    out_specs=(P_(), P_(), P_(), P_()),
+                    check_rep=False)(trees)
+
+            self._exchange_jit = jax.jit(exchange)
+        t0 = time.perf_counter()
+        rows, shard_u, global_u, per_shard = jax.device_get(
+            self._exchange_jit(state.pops.trees))
+        dt = time.perf_counter() - t0
+        S = self.plan.n_island_shards
+        rows, shard_u, global_u = int(rows), int(shard_u), int(global_u)
+        ps = [int(v) for v in np.asarray(per_shard).reshape(-1)]
+        mean_u = sum(ps) / len(ps) if ps else 0.0
+        return {
+            "rows": rows,
+            "shard_unique": shard_u,
+            "global_unique": global_u,
+            "local_dup": rows - shard_u,
+            "cross_shard_dup": shard_u - global_u,
+            "per_shard_unique": ps,
+            # >1.0 = some shard carries more distinct genomes than the
+            # mean (its finalize dedup saves less than its peers')
+            "shard_imbalance": (max(ps) / mean_u) if mean_u else 1.0,
+            "exchanged_bytes": 3 * 4 * rows * max(S - 1, 0),
+            "exchange_time_s": dt,
+            "sharded_dedup": bool(self.plan.sharded_dedup),
+        }

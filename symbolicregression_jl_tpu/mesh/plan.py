@@ -1,0 +1,185 @@
+"""MeshPlan: the explicit island-mesh execution plan.
+
+One object answers every placement question the search runtime has:
+which mesh axes exist, how each leaf of ``SearchDeviceState`` and
+``DeviceData`` is partitioned, whether the iteration donates its input
+state, and how often the mesh runtime exchanges dedup keys across
+shards. ``parallel/mesh.py``'s ``shard_search_state`` /
+``shard_device_data`` delegate here, so the ad-hoc helpers and the mesh
+runtime can never disagree about placement.
+
+Layout (SURVEY.md §5.8): per-island pytrees (``pops``, ``birth``,
+``ref``) shard their leading island axis over the ``island`` mesh axis;
+global state (hall of fame, running stats, eval counter, RNG key,
+telemetry) replicates; dataset rows shard over the ``data`` axis when it
+has more than one shard, else replicate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, ISLAND_AXIS, make_mesh
+
+__all__ = ["MeshPlan"]
+
+
+def _leaf_bytes(x) -> int:
+    return int(getattr(x, "size", 0)) * int(
+        getattr(getattr(x, "dtype", None), "itemsize", 0) or 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """The island-mesh execution plan (immutable; one per Engine).
+
+    ``sharded_dedup`` gates the per-shard finalize-dedup the mesh
+    runtime re-enables under island sharding (bit-exact either way —
+    duplicates copy their group leader's result — so the A/B is a pure
+    perf toggle). ``dedup_exchange_every`` is the iteration period of
+    the cross-shard dedup-key all-gather (0 disables); the exchange is
+    observability only and never changes the search.
+    """
+
+    mesh: Mesh
+    n_island_shards: int
+    n_data_shards: int = 1
+    # None = auto: donate the iteration's input state on accelerator
+    # backends (HBM pressure is real there), do NOT donate on CPU —
+    # XLA:CPU's donated-alias buffers combined with shard_map
+    # collectives deadlock intermittently on the virtual multi-device
+    # mesh (observed ~1-in-4 runs on the 8-virtual-device CI stand-in),
+    # and CPU donation buys nothing.
+    donate_state: Optional[bool] = None
+    sharded_dedup: bool = True
+    dedup_exchange_every: int = 8
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def build(
+        cls,
+        devices: Optional[Sequence[jax.Device]] = None,
+        n_island_shards: Optional[int] = None,
+        n_data_shards: int = 1,
+        **kw,
+    ) -> "MeshPlan":
+        """Build the ``(island, data)`` mesh and wrap it in a plan."""
+        devices = list(devices if devices is not None else jax.devices())
+        if n_island_shards is None:
+            n_island_shards = len(devices) // n_data_shards
+        mesh = make_mesh(
+            devices[: n_island_shards * n_data_shards],
+            n_island_shards=n_island_shards,
+            n_data_shards=n_data_shards,
+        )
+        return cls(mesh=mesh, n_island_shards=n_island_shards,
+                   n_data_shards=n_data_shards, **kw)
+
+    def replace(self, **kw) -> "MeshPlan":
+        return dataclasses.replace(self, **kw)
+
+    # ------------------------------------------------------------------
+    # Per-leaf PartitionSpecs
+    # ------------------------------------------------------------------
+    def island_spec(self) -> P:
+        """Leading-axis island sharding (trailing dims replicated)."""
+        return P(ISLAND_AXIS)
+
+    def replicated_spec(self) -> P:
+        return P()
+
+    def state_specs(self, state) -> Any:
+        """A ``SearchDeviceState``-shaped pytree of ``PartitionSpec``:
+        pops/birth/ref island-sharded on their leading axis, everything
+        global (hof, stats, num_evals, key, telem) replicated."""
+        isl = lambda t: jax.tree.map(lambda _: P(ISLAND_AXIS), t)
+        rep = lambda t: jax.tree.map(lambda _: P(), t)
+        return dataclasses.replace(
+            state,
+            pops=isl(state.pops),
+            hof=rep(state.hof),
+            stats=rep(state.stats),
+            birth=P(ISLAND_AXIS),
+            ref=P(ISLAND_AXIS),
+            num_evals=P(),
+            key=P(),
+            telem=rep(state.telem),
+        )
+
+    def data_specs(self, data) -> Any:
+        """A ``DeviceData``-shaped pytree of ``PartitionSpec``: row axes
+        over the ``data`` mesh axis when it has >1 shard, else
+        replicated (scalars and unit vectors always replicate)."""
+        if self.n_data_shards == 1:
+            return jax.tree.map(lambda _: P(), data)
+        row0 = P(DATA_AXIS)
+        return dataclasses.replace(
+            data,
+            Xt=P(None, DATA_AXIS),
+            y=None if data.y is None else row0,
+            weights=None if data.weights is None else row0,
+            class_idx=None if data.class_idx is None else row0,
+            baseline_loss=P(),
+            use_baseline=P(),
+            x_dims=None if data.x_dims is None else P(),
+            y_dims=None if data.y_dims is None else P(),
+        )
+
+    # ------------------------------------------------------------------
+    # Placement
+    # ------------------------------------------------------------------
+    def _place(self, tree, specs):
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            tree, specs,
+        )
+
+    def place_state(self, state):
+        """Place a SearchDeviceState on the mesh per ``state_specs``."""
+        return self._place(state, self.state_specs(state))
+
+    def place_data(self, data):
+        """Place a DeviceData on the mesh per ``data_specs``."""
+        return self._place(data, self.data_specs(data))
+
+    # ------------------------------------------------------------------
+    # Introspection (telemetry / docs)
+    # ------------------------------------------------------------------
+    def exchange_bytes(self, state) -> Dict[str, int]:
+        """Static per-iteration collective volume estimate (bytes): what
+        the explicit all-gathers move. ``pops``+``birth`` feed both the
+        hall-of-fame merge and the migration pool; ``best_seen`` is the
+        per-island mini-HoF (same leaf shapes as the HoF, one per
+        island)."""
+        pops_b = sum(_leaf_bytes(x) for x in jax.tree.leaves(state.pops))
+        hof_b = sum(_leaf_bytes(x) for x in jax.tree.leaves(state.hof))
+        I = int(state.birth.shape[0])
+        S = self.n_island_shards
+        # all_gather moves each shard's block to the S-1 other shards
+        factor = max(S - 1, 0) / max(S, 1)
+        return {
+            "pops_bytes": int(pops_b * factor),
+            "best_seen_bytes": int(hof_b * I * factor),
+            "birth_bytes": int(I * 4 * factor),
+        }
+
+    def resolve_donation(self) -> bool:
+        """The effective donation policy (see ``donate_state``)."""
+        if self.donate_state is not None:
+            return bool(self.donate_state)
+        return jax.default_backend() != "cpu"
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "axes": {ISLAND_AXIS: self.n_island_shards,
+                     DATA_AXIS: self.n_data_shards},
+            "n_devices": self.n_island_shards * self.n_data_shards,
+            "devices": [str(d) for d in self.mesh.devices.flat],
+            "donate_state": self.resolve_donation(),
+            "sharded_dedup": self.sharded_dedup,
+            "dedup_exchange_every": self.dedup_exchange_every,
+        }

@@ -60,68 +60,33 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def _shard_leading(mesh: Mesh, x: jax.Array, axis_name: str) -> jax.Array:
-    spec = P(axis_name, *([None] * (x.ndim - 1)))
-    return jax.device_put(x, NamedSharding(mesh, spec))
+def _plan_for(mesh: Mesh):
+    """Wrap an existing mesh in the canonical placement plan
+    (mesh/plan.MeshPlan) — one source of truth for per-leaf
+    PartitionSpecs, shared with the graftmesh runtime. Imported lazily:
+    mesh.plan imports ``make_mesh`` from this module."""
+    from ..mesh.plan import MeshPlan
+
+    return MeshPlan(
+        mesh=mesh,
+        n_island_shards=mesh.shape[ISLAND_AXIS],
+        n_data_shards=mesh.shape[DATA_AXIS],
+    )
 
 
 def shard_search_state(state, mesh: Mesh):
     """Place a SearchDeviceState on the mesh: island-major arrays sharded
     on the island axis, global state (HoF, stats, key) replicated.
 
-    The per-island pytrees (pops, birth, ref) all carry the island axis as
-    their leading dimension.
+    The per-island pytrees (pops, birth, ref) all carry the island axis
+    as their leading dimension. Delegates to ``mesh.plan.MeshPlan`` —
+    the legacy helper and the graftmesh runtime share one placement
+    definition.
     """
-    island_sharded = jax.tree.map(
-        lambda x: _shard_leading(mesh, x, ISLAND_AXIS), (state.pops, state.birth, state.ref)
-    )
-    pops, birth, ref = island_sharded
-    rep = replicated(mesh)
-    hof, stats = jax.tree.map(lambda x: jax.device_put(x, rep), (state.hof, state.stats))
-    import dataclasses
-
-    return dataclasses.replace(
-        state,
-        pops=pops,
-        birth=birth,
-        ref=ref,
-        hof=hof,
-        stats=stats,
-        num_evals=jax.device_put(state.num_evals, rep),
-        key=jax.device_put(state.key, rep),
-    )
+    return _plan_for(mesh).place_state(state)
 
 
 def shard_device_data(data, mesh: Mesh):
     """Shard dataset rows over the ``data`` mesh axis (replicate when the
-    data axis has a single shard)."""
-    n_data = mesh.shape[DATA_AXIS]
-
-    def place(x, row_axis):
-        if x is None:
-            return None
-        if n_data == 1 or x.ndim == 0:
-            return jax.device_put(x, replicated(mesh))
-        spec = [None] * x.ndim
-        spec[row_axis] = DATA_AXIS
-        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
-
-    import dataclasses
-
-    return dataclasses.replace(
-        data,
-        Xt=place(data.Xt, 1),
-        y=place(data.y, 0),
-        weights=place(data.weights, 0),
-        class_idx=place(data.class_idx, 0),
-        baseline_loss=jax.device_put(data.baseline_loss, replicated(mesh)),
-        use_baseline=jax.device_put(data.use_baseline, replicated(mesh)),
-        x_dims=(
-            None if data.x_dims is None
-            else jax.device_put(data.x_dims, replicated(mesh))
-        ),
-        y_dims=(
-            None if data.y_dims is None
-            else jax.device_put(data.y_dims, replicated(mesh))
-        ),
-    )
+    data axis has a single shard). Delegates to ``mesh.plan.MeshPlan``."""
+    return _plan_for(mesh).place_data(data)

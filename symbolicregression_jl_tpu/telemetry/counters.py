@@ -67,6 +67,8 @@ __all__ = [
     "step_telemetry",
     "add_cycle_telemetry",
     "member_dup_stats",
+    "member_hash_keys",
+    "unique_key_count",
     "loss_histogram",
 ]
 
@@ -108,8 +110,11 @@ class IterationTelemetry:
     ``finalize_rows`` / ``finalize_unique`` measure structural member
     duplication in the finalize re-eval batch — the duplication the
     fused dedup path exploits (``finalize_rows - finalize_unique`` =
-    dedup hits; zeros when the island axis is sharded, where dedup is
-    disabled and a global sort would need per-iteration collectives)."""
+    dedup hits). The legacy engine reports zeros when the island axis
+    is sharded (dedup disabled there; a global sort would need
+    per-iteration collectives); the mesh runtime (mesh/engine.py)
+    reports the psum of PER-SHARD stats instead — exactly what its
+    per-shard dedup exploits."""
 
     cycle: CycleTelemetry
     finalize_rows: jax.Array     # [] int32
@@ -220,18 +225,13 @@ def _dup_hash_consts(width: int) -> np.ndarray:
             .astype(np.int32) | 1)
 
 
-def member_dup_stats(trees) -> Tuple[jax.Array, jax.Array]:
-    """(rows, unique) over the member axes of a TreeBatch ([I, P, L] or
-    template [I, P, K, L]): how many member rows are structurally
-    identical copies (constants included). This is the duplication the
-    fused dedup eval exploits at finalize (profiling/dup_rate.py
-    measured ~50% at the bench config); ``rows - unique`` = dedup hits.
-
-    Cost: two tiny [N] int32 hash reductions + one ``lax.sort`` of three
-    [N] keys — noise next to the finalize eval itself. Hash-only count:
-    a 93-bit collision would undercount uniques by 1; acceptable for a
-    telemetry counter (the dedup kernel itself verifies exactly).
-    """
+def member_hash_keys(trees) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Three independent [N] int32 member-identity hash keys over the
+    member axes of a TreeBatch ([I, P, L] or template [I, P, K, L]):
+    structurally identical members (constants included) hash to the same
+    3-key tuple. Shared by :func:`member_dup_stats` and the mesh
+    runtime's cross-shard dedup-key exchange (mesh/engine.py), so the
+    two can never disagree on what "duplicate" means."""
     L = trees.arity.shape[-1]
     I, P = trees.arity.shape[0], trees.arity.shape[1]
     N = I * P
@@ -253,18 +253,40 @@ def member_dup_stats(trees) -> Tuple[jax.Array, jax.Array]:
     cbits2 = cbits.reshape(N, -1)
     W = word2.shape[1]
     R = jnp.asarray(_dup_hash_consts(2 * W))
-    keys = [
+    k0, k1, k2 = (
         jnp.sum(word2 * R[k, :W][None, :]
                 + cbits2 * R[k, W:][None, :], axis=1)
         for k in range(3)
-    ]
-    sorted_keys = jax.lax.sort(keys, dimension=0, num_keys=3)
+    )
+    return k0, k1, k2
+
+
+def unique_key_count(keys) -> jax.Array:
+    """Number of distinct 3-key tuples among ``keys`` (three [N] int32
+    arrays): one ``lax.sort`` + neighbor comparison."""
+    sorted_keys = jax.lax.sort(list(keys), dimension=0, num_keys=3)
     prev = lambda x: jnp.concatenate([x[:1], x[:-1]])
-    differs = jnp.zeros((N,), jnp.bool_)
+    differs = jnp.zeros(sorted_keys[0].shape, jnp.bool_)
     for k in sorted_keys:
         differs = differs | (k != prev(k))
-    unique = jnp.int32(1) + jnp.sum(differs.astype(jnp.int32))
-    return jnp.int32(N), unique
+    return jnp.int32(1) + jnp.sum(differs.astype(jnp.int32))
+
+
+def member_dup_stats(trees) -> Tuple[jax.Array, jax.Array]:
+    """(rows, unique) over the member axes of a TreeBatch ([I, P, L] or
+    template [I, P, K, L]): how many member rows are structurally
+    identical copies (constants included). This is the duplication the
+    fused dedup eval exploits at finalize (profiling/dup_rate.py
+    measured ~50% at the bench config); ``rows - unique`` = dedup hits.
+
+    Cost: two tiny [N] int32 hash reductions + one ``lax.sort`` of three
+    [N] keys — noise next to the finalize eval itself. Hash-only count:
+    a 93-bit collision would undercount uniques by 1; acceptable for a
+    telemetry counter (the dedup kernel itself verifies exactly).
+    """
+    keys = member_hash_keys(trees)
+    N = trees.arity.shape[0] * trees.arity.shape[1]
+    return jnp.int32(N), unique_key_count(keys)
 
 
 def loss_histogram(loss: jax.Array) -> jax.Array:

@@ -260,6 +260,25 @@ class Telemetry:
                 pass
         return event
 
+    def mesh(self, *, iteration: int, shards: int,
+             **detail) -> Dict[str, Any]:
+        """Record a graftmesh shard-runtime event (schema ``mesh``):
+        the periodic cross-shard dedup-key exchange results and shard
+        balance. Observability only — cheap, never raises into the
+        search loop, emitted only when the JSONL stream is on."""
+        event = {
+            "event": "mesh",
+            "iteration": int(iteration),
+            "shards": int(shards),
+            "detail": {k: v for k, v in detail.items() if v is not None},
+        }
+        if self.path is not None:
+            try:
+                self._emit(event)
+            except OSError:  # observability must not break the search
+                pass
+        return event
+
     def _emit(self, obj: Dict[str, Any]) -> None:
         # run_id on EVERY event (not just run_start) so concatenated or
         # multi-tenant streams stay attributable: `telemetry report`

@@ -232,6 +232,33 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
             "timeline": [[e["iteration"], e["kind"]] for e in faults[:50]],
         }
 
+    # graftmesh exchange view (docs/SCALING.md): the periodic
+    # cross-shard dedup-key exchanges, aggregated. Duplication split =
+    # what per-shard dedup exploits vs what only a cross-shard scheme
+    # could reach.
+    mesh = [e for e in events if e["event"] == "mesh"]
+    if mesh:
+        last = mesh[-1].get("detail", {})
+        rows = sum(e.get("detail", {}).get("rows", 0) for e in mesh)
+        local_dup = sum(
+            e.get("detail", {}).get("local_dup", 0) for e in mesh)
+        cross_dup = sum(
+            e.get("detail", {}).get("cross_shard_dup", 0) for e in mesh)
+        summary["mesh"] = {
+            "exchanges": len(mesh),
+            "shards": mesh[-1].get("shards"),
+            "local_dup_fraction": _rate(local_dup, rows),
+            "cross_shard_dup_fraction": _rate(cross_dup, rows),
+            "last_shard_imbalance": last.get("shard_imbalance"),
+            "exchanged_bytes_total": sum(
+                e.get("detail", {}).get("exchanged_bytes", 0)
+                for e in mesh),
+            "exchange_time_s_total": sum(
+                e.get("detail", {}).get("exchange_time_s", 0.0)
+                for e in mesh),
+            "sharded_dedup": last.get("sharded_dedup"),
+        }
+
     # graftserve per-request view (docs/SERVING.md): the serve event
     # stream always gets one; a plain search stream gets one only when
     # it actually interleaves multiple run_ids.
@@ -389,6 +416,19 @@ def format_report(summary: Dict[str, Any]) -> str:
         )
         for it_n, kind in fl.get("timeline", [])[:12]:
             lines.append(f"  iter {it_n}: {kind}")
+    ms = summary.get("mesh")
+    if ms:
+        lines.append(
+            f"mesh: {ms['exchanges']} dedup-key exchange(s) over "
+            f"{ms.get('shards')} shard(s)  |  dup local "
+            f"{_fmt_pct(ms['local_dup_fraction'])} / cross-shard "
+            f"{_fmt_pct(ms['cross_shard_dup_fraction'])}"
+            f"  |  imbalance {ms.get('last_shard_imbalance')}"
+            f"  |  {_fmt_num(ms['exchanged_bytes_total'])} B in "
+            f"{ms['exchange_time_s_total']:.3f}s"
+            + ("" if ms.get("sharded_dedup") else
+               "  [sharded dedup OFF]")
+        )
     sv = summary.get("serve")
     if sv:
         cache = sv["cache"]

@@ -79,6 +79,16 @@ EVENT_SPECS: Dict[str, Dict[str, Any]] = {
         "request_id": str,
         "detail": dict,
     },
+    # graftmesh shard-runtime records (docs/SCALING.md): the periodic
+    # cross-shard dedup-key exchange and per-shard balance view.
+    # detail carries rows / shard_unique / global_unique / local_dup /
+    # cross_shard_dup / per_shard_unique / shard_imbalance /
+    # exchanged_bytes / exchange_time_s / sharded_dedup.
+    "mesh": {
+        "iteration": int,
+        "shards": int,
+        "detail": dict,
+    },
 }
 
 # required keys inside each element of iteration.outputs; nullable

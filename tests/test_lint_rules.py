@@ -625,3 +625,113 @@ def test_cli_list_rules(capsys):
     out = capsys.readouterr().out
     for rid in RULES:
         assert rid in out
+
+
+# ---------------------------------------------------------------------------
+# GL008 shard-map-hazard (graftmesh shard_map bodies)
+# ---------------------------------------------------------------------------
+
+
+def test_gl008_flags_host_calls_in_shard_map_body():
+    findings = _lint(
+        """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            v = jax.device_get(x)
+            print(v)
+            return x
+
+        def run(mesh, x):
+            return shard_map(body, mesh=mesh, in_specs=(None,),
+                             out_specs=None)(x)
+        """,
+        path="pkg/mesh/bad_host.py",
+    )
+    assert "GL008" in _ids(findings)
+    assert sum(1 for f in findings if f.rule_id == "GL008") >= 2
+
+
+def test_gl008_flags_item_sync_in_shard_map_body():
+    findings = _lint(
+        """
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            n = x.sum().item()
+            return x + n
+
+        def run(mesh, x):
+            return shard_map(body, mesh=mesh, in_specs=(None,),
+                             out_specs=None)(x)
+        """,
+        path="pkg/mesh/bad_item.py",
+    )
+    assert "GL008" in _ids(findings)
+
+
+def test_gl008_flags_axisless_collectives():
+    findings = _lint(
+        """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            total = jax.lax.psum(x)
+            gathered = jax.lax.all_gather(x)
+            idx = jax.lax.axis_index()
+            return total + gathered.sum() + idx
+
+        def run(mesh, x):
+            return shard_map(body, mesh=mesh, in_specs=(None,),
+                             out_specs=None)(x)
+        """,
+        path="pkg/mesh/bad_axis.py",
+    )
+    gl8 = [f for f in findings if f.rule_id == "GL008"]
+    assert len(gl8) == 3
+
+
+def test_gl008_clean_named_axes_and_host_work_outside():
+    # collectives WITH their axis + host syncs OUTSIDE the mapped body
+    # (including transitively-called helpers) stay quiet
+    findings = _lint(
+        """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def helper(x):
+            return jax.lax.psum(x, "island")
+
+        def body(x):
+            total = helper(x)
+            gathered = jax.lax.all_gather(x, "island", tiled=True)
+            idx = jax.lax.axis_index("island")
+            return total + gathered.sum() + idx
+
+        def run(mesh, x):
+            out = shard_map(body, mesh=mesh, in_specs=(None,),
+                            out_specs=None)(x)
+            host = jax.device_get(out)
+            print(host)
+            return out
+        """,
+        path="pkg/mesh/good.py",
+    )
+    assert "GL008" not in _ids(findings)
+
+
+def test_gl008_ignores_modules_without_shard_map():
+    # the same calls in a module with NO shard_map are out of scope
+    # (GL003's traced-sync rule owns the generic cases)
+    findings = _lint(
+        """
+        import jax
+
+        def f(x):
+            return jax.lax.psum(x)
+        """,
+        path="pkg/mesh/no_smap.py",
+    )
+    assert "GL008" not in _ids(findings)

@@ -242,6 +242,31 @@ def test_options_fingerprint_uncacheable_for_opaque_callables():
     assert options_fingerprint(_opts(elementwise_loss=abs)) is None
 
 
+def test_options_fingerprint_library_operator_callables_cacheable():
+    # jnp-backed operator callables (e.g. unary "cos" resolving to
+    # jnp.cos) carry no __code__ but are process-stable by dotted name
+    # — configs using them must stay cacheable (the serve executable
+    # cache and the mesh AOT key both consume this), and different
+    # operators must not collide
+    a = options_fingerprint(_opts(unary_operators=["cos"]))
+    b = options_fingerprint(_opts(unary_operators=["exp"]))
+    assert a is not None and b is not None and a != b
+
+
+def test_options_fingerprint_rejects_library_instance_callables():
+    # np.vectorize instances report __module__='numpy' but carry
+    # per-instance behavior — two different vectorized lambdas must NOT
+    # collide on a 'lib:' name digest (they'd silently share a compiled
+    # engine); the dotted name fails to resolve back to the instance,
+    # so the config is uncacheable
+    import numpy as np
+
+    f1 = np.vectorize(lambda p, t: (p - t) ** 2)
+    f2 = np.vectorize(lambda p, t: abs(p - t) ** 3)
+    assert options_fingerprint(_opts(elementwise_loss=f1)) is None
+    assert options_fingerprint(_opts(elementwise_loss=f2)) is None
+
+
 def test_options_fingerprint_distinguishes_loss_closures():
     a = options_fingerprint(_opts(elementwise_loss="huber"))
     from symbolicregression_jl_tpu.core.losses import huber_loss

@@ -29,6 +29,10 @@ JAX_PLATFORMS=cpu python tools/fault_smoke.py
 echo "== graftserve: kill-restart-replay + overload smoke (docs/SERVING.md) =="
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
+echo "== graftmesh: mesh dryrun fast tier (docs/SCALING.md) =="
+JAX_PLATFORMS=cpu python -m symbolicregression_jl_tpu.mesh.dryrun \
+    --devices 8 --fast --out "${TMPDIR:-/tmp}/graftmesh/dryrun.json"
+
 echo "== graftbench: benchmark-matrix gate + serve load smoke (docs/BENCHMARKING.md) =="
 JAX_PLATFORMS=cpu python -m symbolicregression_jl_tpu.bench gate \
     --baseline benchmarks/baseline.json \
