@@ -116,6 +116,21 @@ class RuntimeOptions:
     # get_engine returning None falls back to a fresh Engine
     # (uncacheable config: templates, un-fingerprintable callables).
     engine_cache: Optional[Any] = None
+    # graftpulse (docs/OBSERVABILITY.md): active diagnostics riding the
+    # telemetry hub. ``pulse`` keeps a flight-recorder ring of the last
+    # ``pulse_ring`` iterations plus an anomaly detector, and dumps a
+    # graftpulse.bundle.v1 JSON next to the run artifacts on any fault
+    # or nonzero exit. All host-side, bit-neutral to the search.
+    pulse: bool = True
+    pulse_ring: int = 32
+    # Profiler capture windows (jax.profiler traces): pulse_trace_on
+    # arms one at the first iteration; anomalies and SIGUSR2 arm more,
+    # each spanning pulse_trace_iterations iterations, at most
+    # pulse_trace_budget per run. Traces need an output dir (the run's
+    # output_directory / serve artifact dir) to land in.
+    pulse_trace_on: bool = False
+    pulse_trace_iterations: int = 2
+    pulse_trace_budget: int = 2
 
 
 @dataclasses.dataclass
@@ -925,6 +940,43 @@ def equation_search(
     if bar is not None:
         hub.add_sink(ProgressSink(bar))
 
+    # ---- graftpulse active diagnostics (pulse/, docs/OBSERVABILITY.md) --
+    # Flight recorder: sink (per-iteration ring) + watcher (fault/
+    # anomaly/pulse events; a fault triggers the bundle dump — the
+    # watcher fires before the watchdog's os._exit can discard the
+    # evidence). Anomaly detector: rolling stats over signals the loop
+    # already materialized, arming the budgeted profiler capture.
+    # Everything is host-side and bit-neutral to the search.
+    from ..pulse import AnomalyDetector, FlightRecorder, SignalArm, TraceCapture
+
+    pulse_rec = pulse_cap = pulse_sig = None
+    if ropt.pulse and is_rank0:
+        pulse_rec = FlightRecorder(
+            capacity=ropt.pulse_ring,
+            path=(os.path.join(out_dir, "pulse_bundle.json")
+                  if out_dir is not None else None),
+            run_id=ropt.run_id,
+            hub=hub,
+        )
+        hub.add_sink(pulse_rec)
+        hub.add_watcher(pulse_rec.on_event)
+        if out_dir is not None:
+            # Captures need somewhere to land; dir-less runs still get
+            # the detector + recorder ring (dump path also None — the
+            # ring then only feeds a caller-provided dump path).
+            pulse_cap = TraceCapture(
+                out_dir, hub=hub,
+                window_iterations=ropt.pulse_trace_iterations,
+                max_captures=ropt.pulse_trace_budget,
+            )
+            if ropt.pulse_trace_on:
+                pulse_cap.arm("option", 0)
+            pulse_sig = SignalArm().install()
+        hub.add_sink(AnomalyDetector(
+            hub,
+            on_anomaly=(pulse_cap.arm if pulse_cap is not None else None),
+        ))
+
     # ---- graftshield supervision (shield/ package, docs/ROBUSTNESS.md) --
     # Preemption guard: SIGTERM/SIGINT set a flag the budget poll reads;
     # the loop then stops at the iteration boundary with
@@ -993,6 +1045,7 @@ def equation_search(
     # Interactive quit ('q' / ctrl-d on stdin; StdinReader analogue).
     from ..utils.stdin_quit import StdinQuitWatcher
 
+    it = start_iter  # also the exception-dump iteration before the loop
     try:
         # Engage the stdin watcher only for an injected test stream or a
         # genuinely interactive session (Options(interactive_quit=True)
@@ -1132,6 +1185,14 @@ def equation_search(
                 return watchdog.phase("compile" if comp else "iteration",
                                       budget, iteration=it + 1)
 
+            # graftpulse capture boundary: open an armed trace window
+            # before this iteration's device work so the window covers
+            # whole iterations (SIGUSR2 arms here too — the handler only
+            # set a flag, per GL007).
+            if pulse_cap is not None:
+                if pulse_sig is not None and pulse_sig.consume():
+                    pulse_cap.arm("sigusr2", it + 1)
+                pulse_cap.maybe_start(it + 1)
             # sr:iteration span: one profiler step per search iteration,
             # so a perfetto/xplane capture lines up device work with
             # iterations.
@@ -1139,9 +1200,11 @@ def equation_search(
                 for j, (engine, data) in enumerate(zip(engines, datas)):
                     def one(j=j, engine=engine, data=data):
                         dispatch_count["n"] += 1
-                        if injector is not None:
-                            injector.on_dispatch(it + 1)
                         with _phase_for_attempt():
+                            # inside the supervised phase so an injected
+                            # hang is seen by the watchdog deadline
+                            if injector is not None:
+                                injector.on_dispatch(it + 1)
                             return engine.run_iteration(
                                 states[j], data, cur_maxsize_dev,
                                 chunk_sizes=(chunk_sizes
@@ -1267,6 +1330,11 @@ def equation_search(
                 host_fraction=monitor.estimate_work_fraction(),
                 events=iter_events,
             ))
+            # Close the trace window once it has covered its iterations
+            # (after hub.iteration so the capture includes the host-side
+            # sink spans of its last iteration).
+            if pulse_cap is not None:
+                pulse_cap.maybe_stop(it)
             # graftmesh: periodic cross-shard dedup-key exchange →
             # ``mesh`` telemetry events. Stream-gated (the exchange is
             # one small collective; pay it only when someone records
@@ -1327,6 +1395,22 @@ def equation_search(
             elapsed=time.time() - start_time,
         )
     finally:
+        # graftpulse teardown first, while the hub is still open: dump
+        # the flight-recorder ring when the run is exiting on an error
+        # (the fault-watcher path already covered shield-visible
+        # failures; this catches everything else), force-close any open
+        # trace window, release SIGUSR2.
+        exc_type = sys.exc_info()[0]
+        if pulse_rec is not None and exc_type is not None:
+            pulse_rec.dump(trigger={
+                "reason": "exception",
+                "kind": exc_type.__name__,
+                "iteration": int(it),
+            })
+        if pulse_cap is not None:
+            pulse_cap.close(int(it))
+        if pulse_sig is not None:
+            pulse_sig.uninstall()
         # A failing or interrupted search must still release the
         # hub's process-global jax.monitoring compile listener
         # (idempotent after a clean finish) and the graftshield

@@ -103,14 +103,20 @@ def _gate_row(path: str) -> Dict[str, Any]:
     gate_failed = bool(gate.get("failed"))
     eps = [c["metrics"].get("evals_per_sec") for c in cells.values()]
     eps = [v for v in eps if isinstance(v, (int, float))]
+    # graftpulse: anomaly-detector events ride the gate artifacts via
+    # metrics_view's "anomalies" key (older artifacts predate it — 0)
+    anomalies = sum(
+        int(c["metrics"].get("anomalies") or 0) for c in cells.values())
     row.update(
         matrix=rec.get("matrix"),
         platform=rec.get("platform"),
         cells=len(cells),
         failed_cells=sorted(failures),
-        # red = cells crashed OR the embedded gate verdict failed — a
-        # band-regression gate run must not render green here
-        red=bool(failures) or gate_failed,
+        anomalies=anomalies,
+        # red = cells crashed OR the embedded gate verdict failed OR an
+        # otherwise-green run carried anomaly events — "fast but the
+        # detector fired" is a regression signal, not a green row
+        red=bool(failures) or gate_failed or anomalies > 0,
         mean_evals_per_sec=(
             round(sum(eps) / len(eps), 1) if eps else None),
     )
@@ -122,6 +128,8 @@ def _gate_row(path: str) -> Dict[str, Any]:
                     if f.get("status") in ("regression", "missing_cell",
                                            "schema"))
         notes.append(f"gate FAILED ({n_reg} finding(s))")
+    if anomalies and not failures and not gate_failed:
+        notes.append(f"{anomalies} anomaly event(s) in a green run")
     if notes:
         row["note"] = "; ".join(notes)
     return row
@@ -252,6 +260,7 @@ def format_trend(trend: Dict[str, Any]) -> str:
                 f"{r.get('platform') or '?'}  "
                 f"cells={r.get('cells', '-')}  "
                 f"mean evals/s {_fmt(r.get('mean_evals_per_sec'))}  "
+                f"anomalies={r.get('anomalies', '-')}  "
                 f"[{mark}]")
     if trend.get("mesh_scaling"):
         lines.append("measured mesh scaling (profiling/mesh_scaling.py):")

@@ -372,6 +372,19 @@ class MeshEngine(Engine):
         rows, shard_u, global_u = int(rows), int(shard_u), int(global_u)
         ps = [int(v) for v in np.asarray(per_shard).reshape(-1)]
         mean_u = sum(ps) / len(ps) if ps else 0.0
+        # graftpulse shard-balance gauge: each shard's eval work scales
+        # with the rows it actually evaluates — its unique members under
+        # sharded finalize-dedup, its full row slice otherwise (dedup
+        # off = every shard evaluates everything it holds, equally).
+        # max/min ratio: 1.0 = perfectly balanced; the slowest shard
+        # gates the SPMD step, so this bounds the step-time skew the
+        # imbalance alone can cause.
+        if self.plan.sharded_dedup:
+            eval_rows = ps
+        else:
+            eval_rows = [rows // S] * S if S else []
+        ratio = (max(eval_rows) / max(min(eval_rows), 1)
+                 if eval_rows else 1.0)
         return {
             "rows": rows,
             "shard_unique": shard_u,
@@ -382,6 +395,8 @@ class MeshEngine(Engine):
             # >1.0 = some shard carries more distinct genomes than the
             # mean (its finalize dedup saves less than its peers')
             "shard_imbalance": (max(ps) / mean_u) if mean_u else 1.0,
+            "per_shard_eval_rows": eval_rows,
+            "shard_eval_imbalance": ratio,
             "exchanged_bytes": 3 * 4 * rows * max(S - 1, 0),
             "exchange_time_s": dt,
             "sharded_dedup": bool(self.plan.sharded_dedup),

@@ -1,0 +1,194 @@
+"""Rolling anomaly detection over per-iteration search metrics.
+
+A telemetry-hub sink keeping exponentially-weighted mean/variance of
+the per-iteration throughput and host-fraction signals, plus two
+absolute rules (warm recompiles, invalid-candidate fraction). An
+excursion emits an ``anomaly`` event through the hub and — via the
+``on_anomaly`` callback — arms the rate-limited, budgeted profiler
+capture (capture.py), so the evidence window opens AT the anomaly
+instead of requiring a rerun under a hand-driven profiling script.
+
+Watched metrics (docs/OBSERVABILITY.md has the threshold table):
+
+- ``evals_per_sec`` — per-iteration rate (delta evals / delta wall
+  time, not the cumulative average the progress bar shows): a retry
+  storm, host stall, or degraded eval shape collapses it immediately.
+  EWMA/z-score **in log space** (rate noise is multiplicative — a 10x
+  collapse is the same sigma excursion at any absolute throughput),
+  with a relative std floor; compile-bearing iterations are excluded
+  from the rolling stats (they are legitimately 100-1000x slower, and
+  the dedicated ``recompiles`` rule already covers unexpected ones).
+- ``host_fraction`` — the monitor's host-work estimate; a sink or
+  checkpoint path going quadratic drifts it up. EWMA/z-score.
+- ``recompiles`` — any ``jaxpr_trace`` observed after the warmup
+  window is anomalous (warm iterations must not retrace; the first
+  iterations and the chunk-adaptation window compile legitimately).
+- ``invalid_fraction`` — invalid candidates / candidates from the
+  device counters, when the JSONL stream already pulled them (the
+  detector never adds a device transfer of its own); a NaN storm
+  spikes it. Absolute threshold.
+
+Bit-neutral by construction: reads only host-side values the loop
+already materialized, never touches state, keys, or options.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["AnomalyDetector", "AnomalyThresholds"]
+
+
+@dataclasses.dataclass
+class AnomalyThresholds:
+    """Detector tuning; the defaults are the zero-configuration
+    contract CI's pulse-smoke pins (docs/OBSERVABILITY.md)."""
+
+    zscore: float = 4.0           # |z| beyond this fires
+    warmup: int = 5               # samples before z-rules may fire
+    alpha: float = 0.3            # EWMA weight of the newest sample
+    min_std_frac: float = 0.05    # std floor, as a fraction of |mean|
+    invalid_fraction_max: float = 0.5
+    cooldown: int = 8             # iterations between events per metric
+    max_events: int = 32          # per-run event budget
+
+
+class _Rolling:
+    """Exponentially-weighted mean + variance of one scalar signal."""
+
+    def __init__(self, alpha: float) -> None:
+        self.alpha = float(alpha)
+        self.mean: Optional[float] = None
+        self.var = 0.0
+        self.n = 0
+
+    def zscore(self, x: float, min_std: float) -> Optional[float]:
+        if self.mean is None:
+            return None
+        std = max(math.sqrt(max(self.var, 0.0)), min_std, 1e-12)
+        return (x - self.mean) / std
+
+    def update(self, x: float) -> None:
+        self.n += 1
+        if self.mean is None:
+            self.mean = x
+            self.var = 0.0
+            return
+        a = self.alpha
+        delta = x - self.mean
+        self.mean += a * delta
+        # EW variance (West 1979 form): decays old spread, adds the
+        # new sample's contribution around the pre-update mean
+        self.var = (1.0 - a) * (self.var + a * delta * delta)
+
+
+class AnomalyDetector:
+    """Telemetry-hub sink; see module docstring."""
+
+    def __init__(
+        self,
+        hub,
+        *,
+        thresholds: Optional[AnomalyThresholds] = None,
+        on_anomaly: Optional[Callable[[str, int], None]] = None,
+    ) -> None:
+        self.hub = hub
+        self.t = thresholds or AnomalyThresholds()
+        self.on_anomaly = on_anomaly
+        self.events = 0
+        self._roll: Dict[str, _Rolling] = {
+            "evals_per_sec": _Rolling(self.t.alpha),
+            "host_fraction": _Rolling(self.t.alpha),
+        }
+        self._cooldown_until: Dict[str, int] = {}
+        self._last_evals: Optional[float] = None
+        self._last_elapsed: Optional[float] = None
+        self._last_traces: Optional[int] = None
+        self._samples = 0
+
+    # ------------------------------------------------------------------
+    def _fire(self, metric: str, iteration: int, **detail) -> None:
+        if self.events >= self.t.max_events:
+            return
+        if iteration < self._cooldown_until.get(metric, 0):
+            return
+        self._cooldown_until[metric] = iteration + self.t.cooldown
+        self.events += 1
+        armed = False
+        if self.on_anomaly is not None:
+            try:
+                armed = bool(self.on_anomaly(metric, iteration))
+            except Exception:  # arming must never break detection
+                armed = False
+        self.hub.anomaly(metric, iteration=iteration,
+                         armed_capture=armed or None, **detail)
+
+    def _observe_z(self, metric: str, value: Optional[float],
+                   iteration: int, *, log_space: bool = False) -> None:
+        if value is None or not math.isfinite(value):
+            return
+        if log_space and value <= 0.0:
+            return
+        obs = math.log(value) if log_space else value
+        roll = self._roll[metric]
+        if roll.n >= self.t.warmup:
+            min_std = abs(roll.mean or 0.0) * self.t.min_std_frac
+            z = roll.zscore(obs, min_std)
+            if z is not None and abs(z) > self.t.zscore:
+                mean = (math.exp(roll.mean) if log_space and
+                        roll.mean is not None else roll.mean)
+                self._fire(
+                    metric, iteration, value=round(value, 6),
+                    mean=(None if mean is None else round(mean, 6)),
+                    zscore=round(z, 3), threshold=self.t.zscore,
+                )
+        roll.update(obs)
+
+    # -- hub sink protocol ---------------------------------------------
+    def on_iteration(self, ctx) -> None:
+        it = int(ctx.iteration)
+        self._samples += 1
+
+        # per-iteration rate from the cumulative counters the loop
+        # already computed (first sample has no delta; skip it)
+        rate = None
+        if self._last_evals is not None and self._last_elapsed is not None:
+            dt = float(ctx.elapsed) - self._last_elapsed
+            if dt > 0:
+                rate = (float(ctx.num_evals) - self._last_evals) / dt
+        self._last_evals = float(ctx.num_evals)
+        self._last_elapsed = float(ctx.elapsed)
+        traces = int(self.hub.compile_snapshot().get("traces", 0))
+        compiled_this_iter = (self._last_traces is not None
+                              and traces > self._last_traces)
+        if not compiled_this_iter:
+            # compile-bearing iterations are legitimately 100-1000x
+            # slower — feeding them into the rolling rate stats would
+            # inflate the variance past any real stall
+            self._observe_z("evals_per_sec", rate, it, log_space=True)
+        self._observe_z("host_fraction", float(ctx.host_fraction), it)
+
+        # warm recompiles: absolute rule on the jax.monitoring trace
+        # counter delta, past the warmup window (startup compiles and
+        # the chunk-count adaptation retrace legitimately)
+        if (compiled_this_iter and self._samples > self.t.warmup):
+            self._fire(
+                "recompiles", it,
+                value=traces - self._last_traces, threshold=0,
+            )
+        self._last_traces = traces
+
+        # invalid fraction from the device counters, when the stream
+        # already fetched them (ctx.counters stays empty otherwise)
+        worst = None
+        for c in ctx.counters or ():
+            if c and c.get("candidates"):
+                frac = c.get("invalid", 0) / c["candidates"]
+                worst = frac if worst is None else max(worst, frac)
+        if worst is not None and worst > self.t.invalid_fraction_max:
+            self._fire(
+                "invalid_fraction", it, value=round(worst, 6),
+                threshold=self.t.invalid_fraction_max,
+            )

@@ -1,0 +1,69 @@
+"""Prometheus text-exposition builder for the live metrics surface.
+
+graftserve's ``/metrics`` endpoint (serve/metrics.py) renders its
+gauges through this tiny builder rather than depending on the
+``prometheus_client`` package (not in the image, and overkill for a
+read-only exposition of a dozen gauges). The output follows the
+text format v0.0.4: one ``# HELP`` / ``# TYPE`` pair per metric family
+(emitted once, on first sample), then one sample line per label set.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["PromText"]
+
+
+def _escape_label(value: str) -> str:
+    return (str(value)
+            .replace("\\", "\\\\")
+            .replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _escape_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+class PromText:
+    """Accumulate samples, then ``render()`` the exposition body."""
+
+    def __init__(self, prefix: str = "graftserve") -> None:
+        self.prefix = prefix
+        self._lines: List[str] = []
+        self._seen_meta: Dict[str, str] = {}  # family -> declared type
+
+    def _sample(self, name: str, mtype: str, help_text: str,
+                value, labels: Optional[Dict[str, str]]) -> None:
+        family = f"{self.prefix}_{name}" if self.prefix else name
+        if family not in self._seen_meta:
+            self._seen_meta[family] = mtype
+            self._lines.append(f"# HELP {family} {_escape_help(help_text)}")
+            self._lines.append(f"# TYPE {family} {mtype}")
+        label_str = ""
+        if labels:
+            pairs = ",".join(
+                f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items()))
+            label_str = "{" + pairs + "}"
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            v = float("nan")
+        # integers render without a trailing .0 (matches common
+        # exporters; keeps counters diff-friendly)
+        body = repr(int(v)) if v == int(v) and abs(v) < 1e15 else repr(v)
+        self._lines.append(f"{family}{label_str} {body}")
+
+    def gauge(self, name: str, value, help_text: str = "",
+              labels: Optional[Dict[str, str]] = None) -> "PromText":
+        self._sample(name, "gauge", help_text, value, labels)
+        return self
+
+    def counter(self, name: str, value, help_text: str = "",
+                labels: Optional[Dict[str, str]] = None) -> "PromText":
+        self._sample(name, "counter", help_text, value, labels)
+        return self
+
+    def render(self) -> str:
+        return "\n".join(self._lines) + ("\n" if self._lines else "")

@@ -127,6 +127,12 @@ class AdmissionController:
     def utilization(self) -> float:
         return self._total / self.capacity
 
+    def in_flight_by_bucket(self) -> Dict[Tuple[int, int, int], int]:
+        """Snapshot of per-bucket queued+running counts (the /metrics
+        per-bucket admission gauges read this)."""
+        with self._lock:
+            return dict(self._in_flight)
+
     def observe_service_time(self, seconds: float) -> None:
         s = max(float(seconds), 0.0)
         self._avg_service_s = (

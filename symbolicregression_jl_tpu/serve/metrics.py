@@ -1,0 +1,95 @@
+"""The live metrics surface: a Prometheus-text HTTP endpoint.
+
+graftscope's JSONL stream answers "what happened"; this answers "what
+is happening": a tiny stdlib HTTP server exposing ``GET /metrics``
+(Prometheus text format v0.0.4, rendered fresh per scrape from
+``SearchServer.metrics_text``) and ``GET /healthz``. No third-party
+client library, no background sampling thread — the server's own
+counters (admission, executable cache, request records) ARE the state,
+and a scrape just reads them.
+
+Binds 127.0.0.1 by default (the serve API itself is in-process;
+exposing metrics beyond the host is a deployment decision, not a
+default). ``port=0`` picks an ephemeral port — tests and multi-server
+hosts read it back from ``MetricsServer.port``.
+
+docs/OBSERVABILITY.md carries the full metric-name table.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+__all__ = ["MetricsServer", "CONTENT_TYPE"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Serve ``render()`` at /metrics until ``stop()``."""
+
+    def __init__(self, render: Callable[[], str], *, port: int = 0,
+                 host: str = "127.0.0.1") -> None:
+        self.render = render
+        self._requested_port = int(port)
+        self.host = host
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        """The bound port (after ``start()``; resolves port=0)."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - stdlib API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    try:
+                        body = outer.render().encode()
+                    except Exception as e:  # render must not kill a scrape
+                        self.send_error(500, explain=str(e)[:200])
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/healthz":
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", "3")
+                    self.end_headers()
+                    self.wfile.write(b"ok\n")
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *args) -> None:
+                pass  # scrapes every few seconds; stderr stays quiet
+
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="graftserve-metrics", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._httpd = None
+        self._thread = None

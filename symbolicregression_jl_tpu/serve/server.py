@@ -92,6 +92,10 @@ class SearchRequest:
     sample_rows: Optional[int] = None
     bucket: Tuple[int, int, int] = (0, 0, 0)
     index: int = 0  # k-th accepted request of this root, 1-based
+    # graftpulse: arm a profiler-capture window for this request's
+    # search (RuntimeOptions.pulse_trace_on); journaled so a replayed
+    # request still honors it
+    pulse_trace: bool = False
 
     def to_detail(self) -> Dict[str, Any]:
         return {
@@ -105,6 +109,7 @@ class SearchRequest:
             "sample_rows": self.sample_rows,
             "bucket": list(self.bucket),
             "index": int(self.index),
+            "pulse_trace": bool(self.pulse_trace),
         }
 
     @staticmethod
@@ -121,6 +126,7 @@ class SearchRequest:
             sample_rows=d.get("sample_rows"),
             bucket=tuple(d.get("bucket") or (0, 0, 0)),
             index=int(d.get("index", 0)),
+            pulse_trace=bool(d.get("pulse_trace", False)),
         )
 
 
@@ -151,6 +157,9 @@ class _RequestRecord:
         # the request's deadline_s budget is anchored here, not at each
         # resume, so a preempted request cannot restart its clock
         self.first_started_wall: Optional[float] = None
+        # live per-iteration progress (graftpulse /metrics gauges):
+        # written by the worker's logger probe, read by metrics_text
+        self.progress: Optional[Dict[str, Any]] = None
 
     def cancel(self, reason: str = "cancelled") -> None:
         # a terminal cancel (client/deadline) OVERRIDES a pending
@@ -177,18 +186,28 @@ class _RequestRecord:
 
 
 class _InjectorProbe:
-    """RuntimeOptions.logger shim: gives the serve fault injector a
-    per-iteration hook inside a running request's search (the
-    cancel-mid-iteration scenario) without any api/search.py surface."""
+    """RuntimeOptions.logger shim: a per-iteration hook inside a
+    running request's search without any api/search.py surface. Serves
+    two consumers: the serve fault injector (cancel-mid-iteration
+    scenario) and the /metrics per-request progress gauges (iteration,
+    evals, evals/s of every RUNNING request, live)."""
 
     def __init__(self, server: "SearchServer", rec: _RequestRecord) -> None:
         self.server = server
         self.rec = rec
 
-    def log_iteration(self, *, iteration, **_kw) -> None:
+    def log_iteration(self, *, iteration, num_evals=0.0, elapsed=0.0,
+                      **_kw) -> None:
+        it = int(iteration)
+        self.rec.progress = {
+            "iteration": it,
+            "num_evals": float(num_evals),
+            "elapsed_s": float(elapsed),
+            "evals_per_sec": float(num_evals) / max(float(elapsed), 1e-9),
+        }
         inj = self.server._injector
         if inj is not None and inj.should_cancel(
-                self.rec.request.index, int(iteration),
+                self.rec.request.index, it,
                 self.rec.request.request_id):
             self.rec.cancel("cancelled")
 
@@ -247,6 +266,7 @@ class SearchServer:
         cache: Optional[ExecutableCache] = None,
         hang_grace_s: float = 60.0,
         telemetry: bool = True,
+        metrics_port: Optional[int] = None,
     ) -> None:
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
@@ -283,6 +303,15 @@ class SearchServer:
         # per-WORKER-thread request attribution for cache events: a
         # shared attribute would be clobbered across workers
         self._cache_tls = threading.local()
+        # graftpulse live metrics endpoint (serve/metrics.py): None
+        # disables; 0 binds an ephemeral port (read server.metrics.port
+        # back after start()). Scrapes render metrics_text() fresh.
+        self.metrics = None
+        if metrics_port is not None:
+            from .metrics import MetricsServer
+
+            self.metrics = MetricsServer(self.metrics_text,
+                                         port=metrics_port)
         self._recover()
 
     # ------------------------------------------------------------------
@@ -369,6 +398,7 @@ class SearchServer:
         priority: int = 0,
         deadline_s: Optional[float] = None,
         request_id: Optional[str] = None,
+        pulse_trace: bool = False,
     ) -> str:
         """Admit one search request; returns its request_id.
 
@@ -457,6 +487,7 @@ class SearchServer:
                     deadline_s=deadline_s,
                     sample_rows=decision.sample_rows,
                     bucket=decision.bucket, index=self._accepted,
+                    pulse_trace=bool(pulse_trace),
                 )
                 # reserve the id (collision checks see it) but do NOT
                 # enqueue yet: no worker may journal a dependent
@@ -547,6 +578,56 @@ class SearchServer:
         with self._lock:
             return [r.snapshot() for r in self._records.values()]
 
+    def metrics_text(self) -> str:
+        """The /metrics exposition body (Prometheus text format);
+        docs/OBSERVABILITY.md has the metric-name table. Renders fresh
+        from the server's own counters — no sampling thread."""
+        from ..pulse import PromText
+
+        p = PromText("graftserve")
+        p.gauge("queue_depth", self.admission.depth,
+                "Requests queued or running")
+        p.gauge("queue_capacity", self.admission.capacity,
+                "Admission queue capacity")
+        p.gauge("queue_utilization", self.admission.utilization(),
+                "queue_depth / queue_capacity")
+        for bucket, n in sorted(self.admission.in_flight_by_bucket().items()):
+            p.gauge("bucket_in_flight", n,
+                    "Queued+running requests per admission shape bucket",
+                    labels={"bucket": "x".join(str(b) for b in bucket)})
+        stats = self.cache.stats()
+        p.gauge("cache_entries", stats["entries"], "Cached engines")
+        p.counter("cache_hits_total", stats["hits"],
+                  "Executable-cache hits")
+        p.counter("cache_misses_total", stats["misses"],
+                  "Executable-cache misses")
+        p.gauge("cache_hit_rate", stats["hit_rate"] or 0.0,
+                "hits / (hits + misses); 0 before any lookup")
+        with self._lock:
+            by_state: Dict[str, int] = {}
+            running = []
+            for r in self._records.values():
+                by_state[r.state] = by_state.get(r.state, 0) + 1
+                if r.state == "running" and r.progress is not None:
+                    running.append((r.request, dict(r.progress)))
+        for state in ("queued", "running", "done", "failed", "cancelled"):
+            p.gauge("requests", by_state.get(state, 0),
+                    "Requests by lifecycle state",
+                    labels={"state": state})
+        # per-RUNNING-request progress only: terminal requests would
+        # grow the label cardinality without bound over a server's life
+        for req, prog in running:
+            labels = {"request": req.request_id}
+            p.gauge("request_iteration", prog["iteration"],
+                    "Completed iterations of a running request", labels)
+            p.gauge("request_iterations_total", req.niterations,
+                    "Iteration target of a running request", labels)
+            p.gauge("request_evals", prog["num_evals"],
+                    "Cumulative expression evaluations", labels)
+            p.gauge("request_evals_per_sec", prog["evals_per_sec"],
+                    "Cumulative evaluation rate", labels)
+        return p.render()
+
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
@@ -584,6 +665,8 @@ class SearchServer:
                 )
                 t.start()
                 self._threads.append(t)
+        if self.metrics is not None:
+            self.metrics.start()
         return self
 
     def stop(self, drain: bool = False, timeout: Optional[float] = None
@@ -622,6 +705,10 @@ class SearchServer:
         if self._guard is not None:
             self._guard.uninstall()
             self._guard = None
+        if self.metrics is not None:
+            # only on a FULL stop: a stop_timeout return above keeps the
+            # endpoint up — the server is still effectively running
+            self.metrics.stop()
         self.log.serve("shutdown", "", drained=drain)
 
     def wait_idle(self, timeout: Optional[float] = None) -> bool:
@@ -830,6 +917,7 @@ class SearchServer:
             engine_cache=_RequestCacheView(self.cache, req.bucket),
             stop_hook=stop_hook,
             logger=_InjectorProbe(self, rec), log_every_n=1,
+            pulse_trace_on=bool(req.pulse_trace),
         )
         # Hang backstop: the soft deadline above stops at an iteration
         # boundary; a dispatch that never reaches one trips the

@@ -29,6 +29,7 @@ import dataclasses
 import json
 import os
 import signal
+import time
 from typing import Optional, Tuple
 
 __all__ = [
@@ -66,12 +67,18 @@ class FaultPlan:
     sigterm_at_iteration: Optional[int] = None
     # (island, iteration): poison island i at the end of iteration k.
     nan_poison_island: Optional[Tuple[int, int]] = None
+    # (dispatch, seconds): the n-th supervised dispatch blocks for that
+    # long — a deterministic stand-in for a hung device dispatch, the
+    # failure mode the shield watchdog exists for (the sleep happens
+    # INSIDE the supervised phase, so an armed deadline fires).
+    hang_on_dispatch: Optional[Tuple[int, float]] = None
 
     @staticmethod
     def from_json(text: str) -> "FaultPlan":
         d = json.loads(text)
-        if "nan_poison_island" in d and d["nan_poison_island"] is not None:
-            d["nan_poison_island"] = tuple(d["nan_poison_island"])
+        for name in ("nan_poison_island", "hang_on_dispatch"):
+            if d.get(name) is not None:
+                d[name] = tuple(d[name])
         return FaultPlan(**d)
 
 
@@ -95,6 +102,13 @@ class FaultInjector:
     def on_dispatch(self, iteration: int) -> None:
         self.dispatches += 1
         p = self.plan
+        if p.hang_on_dispatch is not None:
+            at, seconds = p.hang_on_dispatch
+            if self.dispatches == at:
+                self._record("hang_on_dispatch", iteration,
+                             dispatch=self.dispatches,
+                             seconds=float(seconds))
+                time.sleep(float(seconds))
         if p.raise_on_dispatch is None:
             return
         first = p.raise_on_dispatch

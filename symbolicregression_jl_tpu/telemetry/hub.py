@@ -32,7 +32,7 @@ import numpy as np
 
 from ..core.options import MUTATION_KINDS
 from .schema import SCHEMA_VERSION
-from .spans import host_span
+from .spans import host_span, set_profiler_warning_hook
 
 __all__ = [
     "IterationContext",
@@ -194,6 +194,14 @@ class Telemetry:
         # graftshield fault audit: per-kind counts, always tracked (the
         # run_end event reports them even at telemetry_interval > 1).
         self.fault_counts: Dict[str, int] = {}
+        # graftpulse: per-metric anomaly counts (same always-tracked
+        # contract as fault_counts) and event watchers — callbacks that
+        # observe every out-of-band event (fault/mesh/anomaly/pulse)
+        # even when the JSONL stream is off. The flight recorder
+        # (pulse/recorder.py) registers here so a fault can trigger its
+        # bundle dump before a watchdog abort kills the process.
+        self.anomaly_counts: Dict[str, int] = {}
+        self._watchers: List[Callable[[Dict[str, Any]], None]] = []
 
         self.path: Optional[str] = None
         enabled = bool(getattr(options, "telemetry", False))
@@ -209,6 +217,12 @@ class Telemetry:
             # truncate any stale file from a previous run with this id
             open(self.path, "w").close()
         self._compiles.start()
+        # spans.py satellite contract: when a profiler annotation is
+        # requested but jax.profiler is unusable, the first failure per
+        # process surfaces as a pulse event instead of a silent no-op
+        # ("the trace is empty" becomes diagnosable).
+        set_profiler_warning_hook(
+            lambda msg: self.pulse("profiler_unusable", error=msg))
         if self.path is not None:
             self._emit({
                 "event": "run_start",
@@ -236,6 +250,22 @@ class Telemetry:
         self._sinks.append(sink)
         return self
 
+    def add_watcher(self, fn: Callable[[Dict[str, Any]], None]
+                    ) -> "Telemetry":
+        """Register an out-of-band event observer: called with every
+        fault/mesh/anomaly/pulse event dict, stream on or off. Watcher
+        exceptions are swallowed — observation must never break the
+        path it observes (the same contract sinks have)."""
+        self._watchers.append(fn)
+        return self
+
+    def _notify(self, event: Dict[str, Any]) -> None:
+        for fn in self._watchers:
+            try:
+                fn(event)
+            except Exception:  # observers must never break the search
+                pass
+
     # ------------------------------------------------------------------
     def fault(self, kind: str, *, iteration: int = 0,
               **detail) -> Dict[str, Any]:
@@ -258,6 +288,7 @@ class Telemetry:
                 self._emit(event)
             except OSError:  # auditing must not break the recovery
                 pass
+        self._notify(event)
         return event
 
     def mesh(self, *, iteration: int, shards: int,
@@ -277,7 +308,55 @@ class Telemetry:
                 self._emit(event)
             except OSError:  # observability must not break the search
                 pass
+        self._notify(event)
         return event
+
+    def anomaly(self, metric: str, *, iteration: int = 0,
+                **detail) -> Dict[str, Any]:
+        """Record a graftpulse anomaly-detector finding (schema
+        ``anomaly``): a rolling-statistics excursion on one watched
+        per-iteration metric. Same discipline as ``fault``: counted
+        in-process always, streamed when the JSONL stream is on, never
+        raises into the loop it observes."""
+        event = {
+            "event": "anomaly",
+            "metric": str(metric),
+            "iteration": int(iteration),
+            "detail": {k: v for k, v in detail.items() if v is not None},
+        }
+        self.anomaly_counts[metric] = self.anomaly_counts.get(metric, 0) + 1
+        if self.path is not None:
+            try:
+                self._emit(event)
+            except OSError:
+                pass
+        self._notify(event)
+        return event
+
+    def pulse(self, kind: str, *, iteration: int = 0,
+              **detail) -> Dict[str, Any]:
+        """Record a graftpulse diagnostics audit event (schema
+        ``pulse``): capture windows armed/started/stopped, bundle
+        dumps, profiler-unusable warnings."""
+        event = {
+            "event": "pulse",
+            "kind": str(kind),
+            "iteration": int(iteration),
+            "detail": {k: v for k, v in detail.items() if v is not None},
+        }
+        if self.path is not None:
+            try:
+                self._emit(event)
+            except OSError:
+                pass
+        self._notify(event)
+        return event
+
+    def compile_snapshot(self) -> Dict[str, int]:
+        """Cumulative jax.monitoring compile/transfer counts seen so far
+        (the anomaly detector diffs consecutive snapshots for its
+        per-iteration recompile signal)."""
+        return self._compiles.snapshot()
 
     def _emit(self, obj: Dict[str, Any]) -> None:
         # run_id on EVERY event (not just run_start) so concatenated or
@@ -403,9 +482,11 @@ class Telemetry:
                     k: v for k, v in self._compiles.snapshot().items()
                     if k != "transfer_guard_hits"
                 },
-                # extra (schema-optional) field: per-kind graftshield
-                # fault counts for the whole run
+                # extra (schema-optional) fields: per-kind graftshield
+                # fault counts and per-metric graftpulse anomaly counts
+                # for the whole run
                 "faults_total": dict(self.fault_counts),
+                "anomalies_total": dict(self.anomaly_counts),
             })
         summary = {
             "stop_reason": stop_reason,

@@ -19,7 +19,12 @@ import json
 import sys
 from typing import Any, Dict, List, Optional
 
-from .schema import SCHEMA_VERSION, load_events, validate_lines
+from .schema import (
+    SCHEMA_VERSION,
+    load_events,
+    load_events_tolerant,
+    validate_lines,
+)
 
 __all__ = ["summarize", "summarize_requests", "metrics_view",
            "format_report", "main"]
@@ -250,6 +255,7 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
             "local_dup_fraction": _rate(local_dup, rows),
             "cross_shard_dup_fraction": _rate(cross_dup, rows),
             "last_shard_imbalance": last.get("shard_imbalance"),
+            "last_shard_eval_imbalance": last.get("shard_eval_imbalance"),
             "exchanged_bytes_total": sum(
                 e.get("detail", {}).get("exchanged_bytes", 0)
                 for e in mesh),
@@ -257,6 +263,33 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
                 e.get("detail", {}).get("exchange_time_s", 0.0)
                 for e in mesh),
             "sharded_dedup": last.get("sharded_dedup"),
+        }
+
+    # graftpulse anomaly view (docs/OBSERVABILITY.md): detector
+    # excursions, per metric, with the small-run timeline — and the
+    # pulse audit trail (capture windows, bundle dumps).
+    anomalies = [e for e in events if e["event"] == "anomaly"]
+    if anomalies:
+        by_metric: Dict[str, int] = {}
+        for e in anomalies:
+            by_metric[e["metric"]] = by_metric.get(e["metric"], 0) + 1
+        summary["anomalies"] = {
+            "count": len(anomalies),
+            "by_metric": by_metric,
+            "timeline": [
+                [e["iteration"], e["metric"]] for e in anomalies[:50]
+            ],
+        }
+    pulse = [e for e in events if e["event"] == "pulse"]
+    if pulse:
+        pk: Dict[str, int] = {}
+        for e in pulse:
+            pk[e["kind"]] = pk.get(e["kind"], 0) + 1
+        summary["pulse"] = {
+            "count": len(pulse),
+            "by_kind": pk,
+            "captures": pk.get("capture_stop", 0),
+            "bundles": pk.get("bundle_dump", 0),
         }
 
     # graftserve per-request view (docs/SERVING.md): the serve event
@@ -278,6 +311,10 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         if run_end.get("faults_total"):
             summary.setdefault("faults", {})["totals_at_end"] = (
                 run_end["faults_total"]
+            )
+        if run_end.get("anomalies_total"):
+            summary.setdefault("anomalies", {})["totals_at_end"] = (
+                run_end["anomalies_total"]
             )
     return summary
 
@@ -327,6 +364,10 @@ def metrics_view(summary: Dict[str, Any]) -> Dict[str, Any]:
         "num_evals": end.get("num_evals"),
         "elapsed_s": end.get("elapsed_s"),
         "stop_reason": end.get("stop_reason"),
+        # graftpulse: detector excursions in this run. Rides into the
+        # bench artifacts via extract.py (extra metrics_view keys are
+        # carried along) and colors `bench trend`'s anomalies column.
+        "anomalies": (summary.get("anomalies") or {}).get("count", 0),
     }
 
 
@@ -416,6 +457,26 @@ def format_report(summary: Dict[str, Any]) -> str:
         )
         for it_n, kind in fl.get("timeline", [])[:12]:
             lines.append(f"  iter {it_n}: {kind}")
+    an = summary.get("anomalies")
+    if an and an.get("count"):
+        kinds = ", ".join(
+            f"{k}={v}" for k, v in sorted(an.get("by_metric", {}).items())
+        )
+        lines.append(
+            f"anomalies: {an['count']} event(s)"
+            + (f"  ({kinds})" if kinds else "")
+        )
+        for it_n, metric in an.get("timeline", [])[:12]:
+            lines.append(f"  iter {it_n}: {metric}")
+    pu = summary.get("pulse")
+    if pu:
+        lines.append(
+            f"pulse: {pu['captures']} profiler capture(s), "
+            f"{pu['bundles']} bundle dump(s)  ("
+            + ", ".join(f"{k}={v}"
+                        for k, v in sorted(pu["by_kind"].items()))
+            + ")"
+        )
     ms = summary.get("mesh")
     if ms:
         lines.append(
@@ -487,6 +548,11 @@ commands:
   report <run.jsonl> [--json]      summarize a run (refuses invalid files)
   report <run.jsonl> --metrics     flat gate-metrics JSON (graftbench view)
   validate <run.jsonl>             check every line against graftscope.v1
+  tail <run.jsonl> [--interval S]  follow a live stream with a refreshing
+       [--once]                    single-screen summary (--once: one shot)
+
+report tolerates a torn final line (the crash artifact of a killed
+writer): it is skipped and counted on stderr, like journal replay.
 """
 
 
@@ -518,9 +584,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         try:
             events = load_events(paths[0])
-        except ValueError as e:
-            print(str(e), file=sys.stderr)
-            return 1
+        except ValueError:
+            # skip-and-count fallback (journal-replay idiom): a torn
+            # tail — the expected artifact of a crashed/killed writer —
+            # must not make the rest of the stream unreadable. Any
+            # OTHER bad line still refuses: mid-file corruption means
+            # records may be missing, and a silently partial report
+            # would misrepresent the run.
+            events, notes = load_events_tolerant(paths[0])
+            hard = [n for n in notes if not n["torn_tail"]]
+            if hard or not events:
+                for n in notes:
+                    print(f"line {n['line']}: {n['reason']}",
+                          file=sys.stderr)
+                print(f"{paths[0]}: unreadable ({len(notes)} bad line(s), "
+                      f"{len(hard)} before the tail)", file=sys.stderr)
+                return 1
+            for n in notes:
+                print(f"warning: skipped torn line {n['line']}: "
+                      f"{n['reason']}", file=sys.stderr)
         summary = summarize(events)
         if as_metrics:
             print(json.dumps(metrics_view(summary)))
@@ -529,5 +611,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print(format_report(summary))
         return 0
+    if cmd == "tail":
+        from .tail import main as tail_main
+
+        return tail_main(rest)
     print(_USAGE, end="", file=sys.stderr)
     return 2

@@ -17,7 +17,7 @@ import json
 from typing import Any, Dict, Iterable, List, Tuple
 
 __all__ = ["SCHEMA_VERSION", "EVENT_SPECS", "validate_event",
-           "validate_lines", "load_events"]
+           "validate_lines", "load_events", "load_events_tolerant"]
 
 SCHEMA_VERSION = "graftscope.v1"
 
@@ -87,6 +87,25 @@ EVENT_SPECS: Dict[str, Dict[str, Any]] = {
     "mesh": {
         "iteration": int,
         "shards": int,
+        "detail": dict,
+    },
+    # graftpulse anomaly-detector findings (docs/OBSERVABILITY.md): a
+    # rolling EWMA/z-score excursion on one watched per-iteration metric
+    # (evals_per_sec / host_fraction / recompiles / invalid_fraction).
+    # detail carries value / mean / zscore / threshold and, when the
+    # excursion armed a profiler capture, armed_capture=true.
+    "anomaly": {
+        "metric": str,
+        "iteration": int,
+        "detail": dict,
+    },
+    # graftpulse diagnostics-layer audit records: kind is one of
+    # capture_armed / capture_start / capture_stop / capture_failed /
+    # bundle_dump / profiler_unusable; detail carries kind-specific
+    # fields (reason, trace_dir, trace files/bytes, bundle path).
+    "pulse": {
+        "kind": str,
+        "iteration": int,
         "detail": dict,
     },
 }
@@ -214,3 +233,37 @@ def load_events(path: str) -> List[dict]:
             + ("" if len(errors) <= 20 else f"\n  ... +{len(errors) - 20} more")
         )
     return [json.loads(l) for l in lines if l.strip()]
+
+
+def load_events_tolerant(path: str) -> Tuple[List[dict], List[dict]]:
+    """Load a possibly-live or crashed stream, skip-and-count bad lines.
+
+    A writer that crashed (or is still appending) leaves a partial last
+    line; ``load_events`` would refuse the whole file over it. This
+    loader mirrors serve/journal.py replay: every undecodable or
+    schema-invalid line is SKIPPED and returned as a corrupt note
+    ``{"line": n, "reason": ..., "torn_tail": bool}`` — torn_tail is
+    True only for the final line (the expected crash/live artifact);
+    anything earlier is mid-file corruption, reported but not fatal.
+    """
+    with open(path) as f:
+        raw = f.readlines()
+    numbered = [(i, l.strip()) for i, l in enumerate(raw, start=1)
+                if l.strip()]
+    events: List[dict] = []
+    notes: List[dict] = []
+    last_lineno = numbered[-1][0] if numbered else 0
+    for lineno, line in numbered:
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            notes.append({"line": lineno, "reason": f"invalid JSON ({e})",
+                          "torn_tail": lineno == last_lineno})
+            continue
+        errs = validate_event(obj)
+        if errs:
+            notes.append({"line": lineno, "reason": errs[0],
+                          "torn_tail": lineno == last_lineno})
+            continue
+        events.append(obj)
+    return events, notes

@@ -17,13 +17,50 @@ Span names (schema-stable, see docs/OBSERVABILITY.md):
   ProgressBar, JSONL emission).
 - ``sr:host:report`` — regressor report building (pareto scoring,
   equation stringification).
+
+Failure discipline: an unusable ``jax.profiler`` must never break the
+search (spans degrade to ``nullcontext``), but it must not be SILENT
+either — an operator staring at an empty graftpulse trace needs to know
+the annotations never existed. The first failure per process reports
+through the hook the telemetry hub registers (a one-time ``pulse``
+event, kind ``profiler_unusable``); later failures stay quiet.
 """
 
 from __future__ import annotations
 
 import contextlib
+from typing import Callable, Optional
 
-__all__ = ["step_span", "host_span"]
+__all__ = ["step_span", "host_span", "set_profiler_warning_hook"]
+
+# one-time-per-process profiler-unusable warning plumbing: the latest
+# constructed Telemetry hub owns the hook (multiple hubs in one process
+# all funnel to whichever registered last — the warning is about the
+# PROCESS's profiler, not one run)
+_warn_hook: Optional[Callable[[str], None]] = None
+_warned = False
+
+
+def set_profiler_warning_hook(hook: Optional[Callable[[str], None]]) -> None:
+    """Register the callback invoked (once per process) when a span is
+    requested but ``jax.profiler`` is unusable. The telemetry hub passes
+    a closure emitting a ``pulse`` event, kind ``profiler_unusable``."""
+    global _warn_hook
+    _warn_hook = hook
+
+
+def _note_profiler_unusable(err: BaseException) -> None:
+    global _warned
+    if _warned:
+        return
+    _warned = True
+    hook = _warn_hook
+    if hook is None:
+        return
+    try:
+        hook(f"{type(err).__name__}: {err}")
+    except Exception:  # the warning must never outcrash the no-op
+        pass
 
 
 def step_span(step_num: int):
@@ -32,7 +69,8 @@ def step_span(step_num: int):
         import jax.profiler as _prof
 
         return _prof.StepTraceAnnotation("sr:iteration", step_num=step_num)
-    except Exception:  # pragma: no cover - profiler unavailable
+    except Exception as e:  # pragma: no cover - profiler unavailable
+        _note_profiler_unusable(e)
         return contextlib.nullcontext()
 
 
@@ -42,5 +80,6 @@ def host_span(name: str):
         import jax.profiler as _prof
 
         return _prof.TraceAnnotation(f"sr:host:{name}")
-    except Exception:  # pragma: no cover - profiler unavailable
+    except Exception as e:  # pragma: no cover - profiler unavailable
+        _note_profiler_unusable(e)
         return contextlib.nullcontext()

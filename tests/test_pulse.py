@@ -1,0 +1,667 @@
+"""graftpulse active diagnostics: flight recorder, anomaly detector,
+triggered capture, live metrics, torn-tail tolerance.
+
+Pins the contracts docs/OBSERVABILITY.md promises for the pulse layer:
+
+- the new ``anomaly`` / ``pulse`` graftscope events validate (and the
+  validator still rejects malformed ones);
+- the flight recorder's ring is bounded, a real hub ``fault`` event
+  triggers its dump, and the bundle's deterministic view is
+  byte-stable across two identical fault-injected runs;
+- the detector's z/absolute rules fire exactly when documented
+  (log-space rate, warmup, cooldown, event budget, compile exclusion);
+- capture windows respect budget + rate limit and a broken profiler
+  disables them instead of failing the run;
+- pulse on vs off is bit-neutral to the search;
+- ``report`` tolerates a crash-torn final line but still refuses
+  mid-file corruption; ``telemetry tail`` folds a live stream
+  incrementally;
+- serve's ``/metrics`` renders valid Prometheus text; ``bench trend``
+  marks an otherwise-green gate artifact carrying anomalies as RED.
+"""
+
+import json
+import os
+import signal
+import urllib.request
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.api.search import RuntimeOptions
+from symbolicregression_jl_tpu.pulse import (
+    AnomalyDetector,
+    AnomalyThresholds,
+    BUNDLE_SCHEMA,
+    FlightRecorder,
+    PromText,
+    SignalArm,
+    TraceCapture,
+    bundle_fingerprint,
+    deterministic_view,
+    validate_bundle,
+)
+from symbolicregression_jl_tpu.shield import faults
+from symbolicregression_jl_tpu.telemetry.hub import Telemetry
+from symbolicregression_jl_tpu.telemetry.report import main as report_main
+from symbolicregression_jl_tpu.telemetry.schema import (
+    load_events_tolerant,
+    validate_event,
+)
+from symbolicregression_jl_tpu.telemetry.tail import TailFollower, TailState
+
+
+@pytest.fixture(autouse=True)
+def _clear_injector():
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# schema: the anomaly / pulse event kinds
+# ---------------------------------------------------------------------------
+
+
+def _base(event, **kw):
+    e = {"schema": "graftscope.v1", "t": 1.0, "run_id": "r",
+         "event": event}
+    e.update(kw)
+    return e
+
+
+@pytest.mark.parametrize("event", [
+    _base("anomaly", metric="evals_per_sec", iteration=3,
+          detail={"value": 6.5, "mean": 4970.0, "zscore": -15.6,
+                  "threshold": 4.0, "armed_capture": True}),
+    _base("anomaly", metric="invalid_fraction", iteration=1,
+          detail={"value": 1.0, "threshold": 0.5}),
+    _base("pulse", kind="capture_stop", iteration=12,
+          detail={"reason": "evals_per_sec", "trace_dir": "/x",
+                  "iterations": 2, "files": 3, "bytes": 1}),
+    _base("pulse", kind="bundle_dump", iteration=2,
+          detail={"reason": "fault", "trigger_kind": "quarantine",
+                  "path": "/x/pulse_bundle.json"}),
+    _base("pulse", kind="profiler_unusable", iteration=0,
+          detail={"error": "RuntimeError: nope"}),
+])
+def test_pulse_events_validate(event):
+    assert validate_event(event) == []
+
+
+@pytest.mark.parametrize("event,fragment", [
+    (_base("anomaly", iteration=3, detail={}), "metric"),
+    (_base("anomaly", metric="evals_per_sec", iteration="3", detail={}),
+     "iteration"),
+    (_base("pulse", iteration=1, detail={}), "kind"),
+    (_base("pulse", kind="capture_start", iteration=1, detail=[]),
+     "detail"),
+])
+def test_malformed_pulse_events_rejected(event, fragment):
+    errors = validate_event(event)
+    assert errors and any(fragment in e for e in errors), errors
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class _Ctx:
+    """Minimal IterationContext stand-in for sink unit tests."""
+
+    def __init__(self, iteration, *, num_evals=100.0, elapsed=1.0,
+                 best_loss=0.5, evals_per_sec=100.0, device_s=0.9,
+                 host_s=0.1, host_fraction=0.1, counters=()):
+        self.iteration = iteration
+        self.num_evals = num_evals
+        self.elapsed = elapsed
+        self.best_loss = best_loss
+        self.evals_per_sec = evals_per_sec
+        self.device_s = device_s
+        self.host_s = host_s
+        self.host_fraction = host_fraction
+        self.counters = counters
+
+
+def test_recorder_ring_is_bounded(tmp_path):
+    rec = FlightRecorder(capacity=4, path=str(tmp_path / "b.json"))
+    for i in range(1, 11):
+        rec.on_iteration(_Ctx(i))
+    bundle = rec.snapshot(trigger={"reason": "manual"})
+    assert [r["iteration"] for r in bundle["iterations"]] == [7, 8, 9, 10]
+    assert bundle["schema"] == BUNDLE_SCHEMA
+    assert validate_bundle(bundle) == []
+
+
+def test_recorder_dump_never_raises_and_budgets(tmp_path):
+    rec = FlightRecorder(capacity=2, path=str(tmp_path / "b.json"),
+                         max_dumps=2)
+    rec.on_iteration(_Ctx(1))
+    assert rec.dump(trigger={"reason": "manual"}) is not None
+    assert rec.dump(trigger={"reason": "manual"}) is not None
+    # over budget: declined, not raised
+    assert rec.dump(trigger={"reason": "manual"}) is None
+    # pathless recorder: declined, not raised
+    assert FlightRecorder().dump(trigger={"reason": "manual"}) is None
+
+
+def test_fault_event_triggers_dump_through_real_hub(tmp_path):
+    """The wiring contract: recorder as hub watcher, a fault event →
+    bundle on disk + a bundle_dump pulse event in the stream."""
+    hub = Telemetry(
+        Options(telemetry=True, save_to_file=False),
+        run_id="hubtest", out_dir=str(tmp_path), niterations=4, nout=1)
+    path = tmp_path / "pulse_bundle.json"
+    rec = FlightRecorder(path=str(path), run_id="hubtest", hub=hub)
+    hub.add_sink(rec)
+    hub.add_watcher(rec.on_event)
+
+    hub.fault("watchdog_timeout", iteration=3, phase="iteration")
+    assert path.exists()
+    bundle = json.loads(path.read_text())
+    assert validate_bundle(bundle) == []
+    assert bundle["trigger"] == {
+        "iteration": 3, "kind": "watchdog_timeout", "reason": "fault"}
+    with open(hub.path) as f:
+        events = [json.loads(l) for l in f]
+    kinds = [(e["event"], e.get("kind")) for e in events]
+    assert ("fault", "watchdog_timeout") in kinds
+    assert ("pulse", "bundle_dump") in kinds
+
+
+def test_deterministic_view_drops_wall_and_seq(tmp_path):
+    rec = FlightRecorder(capacity=2, path=str(tmp_path / "b.json"))
+    rec.on_iteration(_Ctx(1))
+    rec.dump(trigger={"reason": "manual"})
+    bundle = json.loads((tmp_path / "b.json").read_text())
+    view = deterministic_view(bundle)
+    assert "wall" not in view and "dump_seq" not in view
+    assert view["iterations"][0]["iteration"] == 1
+    # wall-clock numbers live only in the wall subtree
+    assert "evals_per_sec" not in view["iterations"][0]
+    assert bundle["wall"]["iterations"][0]["evals_per_sec"] == 100.0
+
+
+def test_validate_bundle_catches_malformed():
+    assert validate_bundle([]) == ["bundle is list, expected object"]
+    errors = validate_bundle({"schema": "nope", "run_id": 3})
+    assert any("schema" in e for e in errors)
+    assert any("run_id" in e for e in errors)
+    assert any("missing field" in e for e in errors)
+
+
+# ---------------------------------------------------------------------------
+# anomaly detector (synthetic hub)
+# ---------------------------------------------------------------------------
+
+
+class _FakeHub:
+    def __init__(self, traces=0):
+        self.anomalies = []
+        self.traces = traces
+
+    def anomaly(self, metric, *, iteration, **detail):
+        self.anomalies.append((metric, iteration, detail))
+
+    def compile_snapshot(self):
+        return {"traces": self.traces}
+
+
+def _feed_rate(det, iterations, rate, start=1, dt=1.0):
+    """Feed iterations at a constant per-iteration eval rate."""
+    for k in range(iterations):
+        it = start + k
+        det.on_iteration(_Ctx(
+            it, num_evals=rate * dt * it, elapsed=dt * it,
+            host_fraction=0.1))
+
+
+def test_rate_collapse_fires_after_warmup():
+    hub = _FakeHub()
+    armed = []
+    det = AnomalyDetector(
+        hub, on_anomaly=lambda m, i: armed.append(m) or True)
+    _feed_rate(det, 7, 1000.0)
+    assert hub.anomalies == []
+    # 100x collapse at iteration 8: decisive in log space
+    det.on_iteration(_Ctx(8, num_evals=7010.0, elapsed=8.0))
+    metrics = [m for m, _, _ in hub.anomalies]
+    assert metrics == ["evals_per_sec"]
+    detail = hub.anomalies[0][2]
+    assert detail["zscore"] < -4.0
+    assert detail["value"] == pytest.approx(10.0)
+    assert detail["armed_capture"] is True
+    assert armed == ["evals_per_sec"]
+
+
+def test_warmup_suppresses_early_firing():
+    hub = _FakeHub()
+    det = AnomalyDetector(hub)
+    _feed_rate(det, 3, 1000.0)
+    det.on_iteration(_Ctx(4, num_evals=3010.0, elapsed=4.0))
+    assert hub.anomalies == []
+
+
+def test_cooldown_and_event_budget():
+    hub = _FakeHub()
+    t = AnomalyThresholds(cooldown=8, max_events=2)
+    det = AnomalyDetector(hub, thresholds=t)
+    counters = ({"candidates": 100, "invalid": 90},)
+    det.on_iteration(_Ctx(1, counters=counters))
+    det.on_iteration(_Ctx(2, counters=counters))   # cooled down
+    det.on_iteration(_Ctx(9, counters=counters))   # past cooldown
+    det.on_iteration(_Ctx(30, counters=counters))  # over budget
+    assert [(m, i) for m, i, _ in hub.anomalies] == [
+        ("invalid_fraction", 1), ("invalid_fraction", 9)]
+
+
+def test_compile_bearing_iterations_excluded_from_rate():
+    """A legitimately slow compile iteration must not poison the
+    rolling stats, and a warm recompile past warmup fires the
+    absolute rule."""
+    hub = _FakeHub(traces=1)
+    det = AnomalyDetector(hub)
+    _feed_rate(det, 6, 1000.0)
+    # iteration 7: a recompile AND a 100x-slow iteration — excluded
+    # from the rate stats, fired as a recompile anomaly instead
+    hub.traces += 1
+    det.on_iteration(_Ctx(7, num_evals=6010.0, elapsed=7.0))
+    assert [m for m, _, _ in hub.anomalies] == ["recompiles"]
+    # back to the normal rate: no evals_per_sec anomaly (the slow
+    # sample never entered the stats, so the mean is still 1000)
+    hub.traces += 0
+    det.on_iteration(_Ctx(8, num_evals=7010.0, elapsed=8.0))
+    assert [m for m, _, _ in hub.anomalies] == ["recompiles"]
+
+
+def test_host_fraction_drift_fires():
+    hub = _FakeHub()
+    det = AnomalyDetector(hub)
+    for it in range(1, 8):
+        det.on_iteration(_Ctx(it, num_evals=float(it), elapsed=float(it),
+                              host_fraction=0.10))
+    det.on_iteration(_Ctx(8, num_evals=8.0, elapsed=8.0,
+                          host_fraction=0.95))
+    assert ("host_fraction" in [m for m, _, _ in hub.anomalies])
+
+
+# ---------------------------------------------------------------------------
+# capture windows (stubbed profiler via hub audit, no jax tracing)
+# ---------------------------------------------------------------------------
+
+
+class _PulseLog:
+    def __init__(self):
+        self.events = []
+
+    def pulse(self, kind, *, iteration, **detail):
+        self.events.append((kind, iteration, detail))
+
+
+def _stub_profiler(monkeypatch, fail_start=False):
+    import jax.profiler
+
+    calls = {"start": 0, "stop": 0}
+
+    def start_trace(d, create_perfetto_trace=True):
+        calls["start"] += 1
+        if fail_start:
+            raise RuntimeError("profiler broken")
+
+    def stop_trace():
+        calls["stop"] += 1
+
+    monkeypatch.setattr(jax.profiler, "start_trace", start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", stop_trace)
+    return calls
+
+
+def test_capture_window_lifecycle_and_budget(tmp_path, monkeypatch):
+    calls = _stub_profiler(monkeypatch)
+    log = _PulseLog()
+    clock = {"t": 0.0}
+    cap = TraceCapture(str(tmp_path), hub=log, window_iterations=2,
+                       max_captures=1, min_interval_s=30.0,
+                       clock=lambda: clock["t"])
+    assert cap.arm("anomaly", 3)
+    assert not cap.arm("sigusr2", 3)      # already armed
+    assert cap.maybe_start(4)
+    assert not cap.maybe_stop(4)          # covered 1 < window 2
+    assert cap.maybe_stop(5)              # covered 2
+    assert calls == {"start": 1, "stop": 1}
+    assert not cap.arm("anomaly", 6)      # budget exhausted
+    assert [k for k, _, _ in log.events] == [
+        "capture_armed", "capture_start", "capture_stop"]
+    stop_detail = log.events[-1][2]
+    assert stop_detail["iterations"] == 2
+    assert stop_detail["trace_dir"].endswith("capture01")
+
+
+def test_capture_rate_limit_spaces_windows(tmp_path, monkeypatch):
+    _stub_profiler(monkeypatch)
+    clock = {"t": 0.0}
+    cap = TraceCapture(str(tmp_path), window_iterations=1,
+                       max_captures=5, min_interval_s=30.0,
+                       clock=lambda: clock["t"])
+    assert cap.arm("a", 1) and cap.maybe_start(1) and cap.maybe_stop(1)
+    assert not cap.arm("b", 2)            # inside the 30s window
+    clock["t"] = 31.0
+    assert cap.arm("b", 2)
+
+
+def test_broken_profiler_disables_not_raises(tmp_path, monkeypatch):
+    _stub_profiler(monkeypatch, fail_start=True)
+    log = _PulseLog()
+    cap = TraceCapture(str(tmp_path), hub=log)
+    assert cap.arm("anomaly", 1)
+    assert not cap.maybe_start(2)
+    assert cap.disabled
+    assert not cap.arm("anomaly", 3)      # stays off for the run
+    kinds = [k for k, _, _ in log.events]
+    assert kinds == ["capture_armed", "capture_failed"]
+    assert "profiler broken" in log.events[-1][2]["error"]
+
+
+def test_signal_arm_consumes_once():
+    arm = SignalArm().install()
+    try:
+        assert arm.installed
+        assert not arm.consume()
+        os.kill(os.getpid(), signal.SIGUSR2)
+        # signal delivery is synchronous to this thread on the kill
+        assert arm.consume()
+        assert not arm.consume()
+    finally:
+        arm.uninstall()
+    assert not arm.installed
+
+
+def test_spans_one_time_profiler_warning(monkeypatch):
+    from symbolicregression_jl_tpu.telemetry import spans
+
+    monkeypatch.setattr(spans, "_warned", False)
+    seen = []
+    spans.set_profiler_warning_hook(seen.append)
+    try:
+        spans._note_profiler_unusable(RuntimeError("no profiler"))
+        spans._note_profiler_unusable(RuntimeError("again"))
+        assert seen == ["RuntimeError: no profiler"]
+    finally:
+        spans.set_profiler_warning_hook(None)
+
+
+# ---------------------------------------------------------------------------
+# full-search contracts: determinism + bit-neutrality (3 tiny searches,
+# shared compile cache with tests/test_shield.py shapes)
+# ---------------------------------------------------------------------------
+
+
+def _problem(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, (n, 2)).astype(np.float32)
+    y = (2.0 * X[:, 0] + X[:, 1] * X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options(tmp_path, **kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=[],
+        maxsize=10,
+        populations=2,
+        population_size=12,
+        tournament_selection_n=4,
+        ncycles_per_iteration=4,
+        save_to_file=True,
+        output_directory=str(tmp_path),
+        telemetry=True,
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _fault_run(tmp_path, sub, *, pulse=True):
+    X, y = _problem()
+    faults.install(faults.FaultInjector(
+        faults.FaultPlan(nan_poison_island=(0, 2))))
+    try:
+        state, _ = equation_search(
+            X, y, options=_options(tmp_path / sub),
+            runtime_options=RuntimeOptions(
+                niterations=3, run_id="det", seed=7, verbosity=0,
+                pulse=pulse),
+            return_state=True)
+    finally:
+        faults.clear()
+    return state, os.path.join(tmp_path, sub, "det")
+
+
+@pytest.mark.slow  # 3 full searches; CI's pulse-smoke job covers the
+# fault->anomaly->capture->bundle path end-to-end on every push
+def test_bundle_deterministic_and_pulse_bit_neutral(tmp_path):
+    """Two identical fault-injected runs dump byte-identical
+    deterministic views (same fingerprint); a third with pulse OFF
+    produces a bit-identical hall of fame — recorder + detector read
+    only what the loop already computed."""
+    s1, dir1 = _fault_run(tmp_path, "a", pulse=True)
+    s2, dir2 = _fault_run(tmp_path, "b", pulse=True)
+    b1 = os.path.join(dir1, "pulse_bundle.json")
+    b2 = os.path.join(dir2, "pulse_bundle.json")
+    assert os.path.exists(b1) and os.path.exists(b2)
+    with open(b1) as f:
+        bundle1 = json.load(f)
+    with open(b2) as f:
+        bundle2 = json.load(f)
+    assert validate_bundle(bundle1) == []
+    assert bundle1["trigger"]["kind"] == "quarantine"
+    blob1 = json.dumps(deterministic_view(bundle1), sort_keys=True)
+    blob2 = json.dumps(deterministic_view(bundle2), sort_keys=True)
+    assert blob1 == blob2
+    assert bundle_fingerprint(b1) == bundle_fingerprint(b2)
+    # device counters made it into the ring (stream pulled them)
+    assert bundle1["iterations"][-1]["counters"] is not None
+
+    s3, dir3 = _fault_run(tmp_path, "c", pulse=False)
+    assert not os.path.exists(os.path.join(dir3, "pulse_bundle.json"))
+    a, c = s1.device_states[0], s3.device_states[0]
+    for f in ("arity", "op", "feat", "const", "length"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.hof.trees, f)),
+            np.asarray(getattr(c.hof.trees, f)))
+    np.testing.assert_array_equal(np.asarray(a.hof.cost),
+                                  np.asarray(c.hof.cost))
+    np.testing.assert_array_equal(np.asarray(a.pops.cost),
+                                  np.asarray(c.pops.cost))
+
+
+# ---------------------------------------------------------------------------
+# torn-tail tolerance (report) + live tail follower
+# ---------------------------------------------------------------------------
+
+
+def _write_stream(path, events, tail=""):
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+        f.write(tail)
+
+
+def _mini_events():
+    return [
+        _base("run_start", run_id="torn", backend="cpu", n_devices=1,
+              nout=1, niterations=4, telemetry_interval=1, options={},
+              engines=[]),
+        _base("anomaly", metric="evals_per_sec", iteration=2,
+              detail={"value": 1.0, "zscore": -9.9, "threshold": 4.0}),
+        _base("pulse", kind="capture_armed", iteration=2,
+              detail={"reason": "evals_per_sec"}),
+    ]
+
+
+def test_report_tolerates_torn_tail(tmp_path, capsys):
+    path = str(tmp_path / "run.jsonl")
+    _write_stream(path, _mini_events(), tail='{"schema": "graftsco')
+    events, notes = load_events_tolerant(path)
+    assert len(events) == 3
+    assert [n["torn_tail"] for n in notes] == [True]
+    assert report_main(["report", path, "--json"]) == 0
+    captured = capsys.readouterr()
+    assert "skipped torn line 4" in captured.err
+    summary = json.loads(captured.out)
+    assert summary["anomalies"]["count"] == 1
+    assert summary["pulse"]["by_kind"] == {"capture_armed": 1}
+    # the gate metrics view carries the anomaly count
+    assert report_main(["report", path, "--metrics"]) == 0
+    metrics = json.loads(capsys.readouterr().out)
+    assert metrics["anomalies"] == 1
+
+
+def test_report_still_refuses_midfile_corruption(tmp_path, capsys):
+    path = str(tmp_path / "run.jsonl")
+    evs = _mini_events()
+    with open(path, "w") as f:
+        f.write(json.dumps(evs[0]) + "\n")
+        f.write("garbage not json\n")
+        f.write(json.dumps(evs[1]) + "\n")
+    assert report_main(["report", path]) == 1
+    assert "unreadable" in capsys.readouterr().err
+
+
+def test_tail_follower_incremental_with_torn_tail(tmp_path):
+    path = str(tmp_path / "live.jsonl")
+    evs = _mini_events()
+    _write_stream(path, evs[:1], tail='{"partial')
+    fol = TailFollower(path)
+    assert fol.poll() == 1
+    assert fol.state.run["run_id"] == "torn"
+    # the writer's next flush abandons the torn line and appends more
+    with open(path, "a") as f:
+        f.write("\n")
+        f.write(json.dumps(_base(
+            "iteration", iteration=2, num_evals=500.0, elapsed=1.0,
+            evals_per_sec=500.0, best_loss=0.25, host_fraction=0.05,
+            outputs=[])) + "\n")
+        f.write(json.dumps(_base(
+            "run_end", stop_reason="niterations", iterations=2,
+            num_evals=500.0, elapsed_s=1.0)) + "\n")
+    n = fol.poll()
+    assert n == 2  # the completed partial line is skipped, counted
+    assert fol.state.skipped == 1
+    assert fol.state.iterations == 2
+    assert fol.state.end is not None
+    screen = fol.state.render()
+    assert "run END: niterations" in screen
+    assert "torn/skipped" in screen
+
+
+def test_tail_state_renders_counters():
+    st = TailState()
+    for e in _mini_events():
+        st.update(e)
+    st.update(_base("fault", kind="retry", iteration=2, detail={}))
+    screen = st.render()
+    assert "anomalies: evals_per_sec=1" in screen
+    assert "pulse: capture_armed=1" in screen
+    assert "faults: retry=1" in screen
+    assert "run live..." in screen
+
+
+# ---------------------------------------------------------------------------
+# live metrics: PromText + the serve /metrics endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_promtext_format():
+    p = PromText("graftserve")
+    p.gauge("queue_depth", 3, "Requests queued or running")
+    p.gauge("bucket_in_flight", 2, "per bucket",
+            labels={"bucket": '256x2x1"esc\\'})
+    p.gauge("bucket_in_flight", 1, "per bucket", labels={"bucket": "b"})
+    p.counter("cache_hits_total", 7.0, "hits")
+    p.gauge("hit_rate", 0.875, "ratio")
+    text = p.render()
+    lines = text.splitlines()
+    # HELP/TYPE once per family, even with two label sets
+    assert lines.count("# TYPE graftserve_bucket_in_flight gauge") == 1
+    assert "graftserve_queue_depth 3" in lines
+    assert ('graftserve_bucket_in_flight{bucket="256x2x1\\"esc\\\\"} 2'
+            in lines)
+    assert "graftserve_cache_hits_total 7" in lines  # int, no .0
+    assert "graftserve_hit_rate 0.875" in lines
+    assert text.endswith("\n")
+
+
+def test_server_metrics_text_and_http(tmp_path):
+    from symbolicregression_jl_tpu.serve.metrics import (
+        CONTENT_TYPE,
+        MetricsServer,
+    )
+    from symbolicregression_jl_tpu.serve.server import SearchServer
+
+    server = SearchServer(str(tmp_path / "root"), capacity=3,
+                          telemetry=False)
+    text = server.metrics_text()
+    for family in ("graftserve_queue_depth", "graftserve_queue_capacity",
+                   "graftserve_cache_hit_rate",
+                   'graftserve_requests{state="running"}'):
+        assert family in text
+    assert "graftserve_queue_capacity 3" in text
+
+    ms = MetricsServer(server.metrics_text, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{ms.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == CONTENT_TYPE
+            assert b"graftserve_queue_depth" in r.read()
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            assert r.read() == b"ok\n"
+        try:
+            urllib.request.urlopen(base + "/nope", timeout=5)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        ms.stop()
+
+
+# ---------------------------------------------------------------------------
+# bench trend: anomalies in a green run make the row red
+# ---------------------------------------------------------------------------
+
+
+def _gate_artifact(anomalies):
+    return {
+        "schema": "graftbench.result.v1",
+        "matrix": "cpu-mini",
+        "platform": "cpu",
+        "cells": {
+            "plain/s0": {"metrics": {"evals_per_sec": 1000.0,
+                                     "anomalies": anomalies}},
+        },
+        "failures": {},
+        "gate": {"failed": False, "findings": []},
+    }
+
+
+@pytest.mark.parametrize("anomalies,red", [(0, False), (2, True)])
+def test_trend_flags_anomalous_green_gate(tmp_path, anomalies, red):
+    from symbolicregression_jl_tpu.bench.trend import (
+        build_trend,
+        format_trend,
+    )
+
+    hist = tmp_path / "benchmarks" / "history"
+    hist.mkdir(parents=True)
+    with open(hist / "gate_r07.json", "w") as f:
+        json.dump(_gate_artifact(anomalies), f)
+    trend = build_trend(str(tmp_path))
+    row = trend["gates"][0]
+    assert row["anomalies"] == anomalies
+    assert row["red"] is red
+    text = format_trend(trend)
+    assert f"anomalies={anomalies}" in text
+    if red:
+        assert "anomaly event(s) in a green run" in row["note"]
+        assert "RED" in text

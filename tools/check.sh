@@ -26,6 +26,9 @@ JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 echo "== graftshield: fault-injection smoke (docs/ROBUSTNESS.md) =="
 JAX_PLATFORMS=cpu python tools/fault_smoke.py
 
+echo "== graftpulse: anomaly-capture + watchdog-bundle smoke (docs/OBSERVABILITY.md) =="
+JAX_PLATFORMS=cpu python tools/pulse_smoke.py
+
 echo "== graftserve: kill-restart-replay + overload smoke (docs/SERVING.md) =="
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
 

@@ -1,0 +1,201 @@
+"""CI graftpulse smoke: the active-diagnostics layer end to end on CPU
+(docs/OBSERVABILITY.md; tools/check.sh and the CI ``pulse-smoke`` job)::
+
+    python tools/pulse_smoke.py [out_dir]
+
+Two scenarios, each a full ``equation_search`` with ``pulse`` left at
+its zero-config default and the deterministic fault harness
+(shield/faults.py) providing the trouble:
+
+1. **anomaly+capture+bundle**: dispatch 10 fails 3 consecutive times
+   (→ retry backoff sleeps ≈3.5s → the per-iteration evals/s collapses
+   → the EWMA z-score anomaly detector fires → a profiler capture is
+   armed, started, and stopped), then island 0 is NaN-poisoned at
+   iteration 11 (→ quarantine fault → flight-recorder dump). Asserts
+   the ``anomaly`` event, a schema-valid ``pulse_bundle.json``, the
+   ``capture_armed``/``capture_start``/``capture_stop`` pulse events,
+   a non-empty perfetto trace on disk, and that the whole stream still
+   validates against graftscope.v1.
+2. **watchdog-trip bundle**: a child process (re-invoking this script
+   with ``--watchdog-child``) hangs dispatch 5 for 30s
+   (``FaultPlan(hang_on_dispatch=...)``) under a 0.5s
+   ``iteration_deadline``, so the shield watchdog trips, emits the
+   ``watchdog_timeout`` fault and then aborts with ``os._exit(124)``.
+   The parent asserts rc 124 AND that the flight recorder's
+   fault-watcher dump landed a valid bundle with that trigger BEFORE
+   the abort — the "evidence survives the kill" guarantee.
+
+Exits nonzero on the first failed scenario; telemetry JSONL, bundle,
+and trace files are left under ``<out_dir>`` as the CI artifact either
+way.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SYMBOLIC_REGRESSION_IS_TESTING", "true")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def _problem():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2.0, 2.0, (128, 2)).astype(np.float32)
+    y = (X[:, 0] * 2.0 + X[:, 1] * X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options(out_base, **kw):
+    from symbolicregression_jl_tpu import Options
+
+    base = dict(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=2,
+        tournament_selection_n=4,
+        optimizer_probability=0.0,
+        output_directory=out_base,
+        telemetry=True,
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _events(out_base, run_id, event):
+    path = os.path.join(out_base, run_id, "telemetry.jsonl")
+    with open(path) as f:
+        return [json.loads(l) for l in f
+                if f'"event": "{event}"' in l]
+
+
+def _load_bundle(out_base, run_id):
+    from symbolicregression_jl_tpu.pulse import validate_bundle
+
+    path = os.path.join(out_base, run_id, "pulse_bundle.json")
+    assert os.path.exists(path), f"no flight-recorder bundle at {path}"
+    with open(path) as f:
+        bundle = json.load(f)
+    errors = validate_bundle(bundle)
+    assert not errors, f"bundle failed validation: {errors}"
+    return bundle
+
+
+def scenario_anomaly_capture(out_base) -> None:
+    from symbolicregression_jl_tpu import equation_search
+    from symbolicregression_jl_tpu.api.search import RuntimeOptions
+    from symbolicregression_jl_tpu.shield import faults
+    from symbolicregression_jl_tpu.telemetry.schema import load_events
+
+    X, y = _problem()
+    # 3 consecutive dispatch failures at dispatch 10 stall the loop
+    # behind the shield's 0.5+1+2s backoff, collapsing the
+    # per-iteration evals/s far past the detector's 4-sigma band (the
+    # 5-sample warmup is fed by the clean warm iterations before it);
+    # the NaN storm at iteration 11 then exercises quarantine → the
+    # fault-triggered flight-recorder dump.
+    faults.install(faults.FaultInjector(faults.FaultPlan(
+        nan_poison_island=(0, 11), raise_on_dispatch=10, raise_count=3)))
+    try:
+        equation_search(
+            X, y, options=_options(out_base),
+            runtime_options=RuntimeOptions(
+                niterations=13, run_id="smoke-pulse", seed=5, verbosity=0))
+    finally:
+        faults.clear()
+
+    # the whole stream — including the new anomaly/pulse kinds — still
+    # validates against graftscope.v1
+    run_dir = os.path.join(out_base, "smoke-pulse")
+    load_events(os.path.join(run_dir, "telemetry.jsonl"))
+
+    anomalies = _events(out_base, "smoke-pulse", "anomaly")
+    assert anomalies, "no anomaly event in the stream"
+    metrics = {e["metric"] for e in anomalies}
+    assert "evals_per_sec" in metrics, metrics
+
+    pulse_kinds = {e["kind"] for e in _events(out_base, "smoke-pulse",
+                                              "pulse")}
+    assert {"capture_armed", "capture_start",
+            "capture_stop"} <= pulse_kinds, pulse_kinds
+    assert "bundle_dump" in pulse_kinds, pulse_kinds
+
+    bundle = _load_bundle(out_base, "smoke-pulse")
+    assert bundle["trigger"]["reason"] == "fault", bundle["trigger"]
+    assert bundle["iterations"], "bundle ring is empty"
+
+    traces = glob.glob(os.path.join(
+        run_dir, "pulse_traces", "**", "perfetto_trace.json.gz"),
+        recursive=True)
+    assert traces, f"no perfetto trace under {run_dir}/pulse_traces"
+    assert all(os.path.getsize(t) > 0 for t in traces), "empty trace file"
+
+
+def scenario_watchdog_bundle(out_base) -> None:
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--watchdog-child", out_base],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 124, (
+        f"child rc={proc.returncode}, expected the watchdog's 124\n"
+        f"stderr tail: {proc.stderr[-2000:]}")
+    bundle = _load_bundle(out_base, "smoke-watchdog")
+    trig = bundle["trigger"]
+    assert trig["reason"] == "fault", trig
+    assert trig["kind"] == "watchdog_timeout", trig
+
+
+def _watchdog_child(out_base) -> None:
+    """Child half of scenario 2: run until the watchdog aborts us."""
+    from symbolicregression_jl_tpu import equation_search
+    from symbolicregression_jl_tpu.api.search import RuntimeOptions
+    from symbolicregression_jl_tpu.shield import faults
+
+    X, y = _problem()
+    # compile-bearing iterations are unsupervised (compile_budget=None);
+    # dispatch 5 is warm, hangs 30s against a 0.5s deadline → the
+    # watchdog fires (0.25s poll) → watchdog_timeout fault → recorder
+    # dump → os._exit(124). The 30s bound means a broken watchdog still
+    # lets the child finish and exit 1 instead of wedging CI.
+    faults.install(faults.FaultInjector(
+        faults.FaultPlan(hang_on_dispatch=(5, 30.0))))
+    equation_search(
+        X, y, options=_options(out_base, iteration_deadline=0.5),
+        runtime_options=RuntimeOptions(
+            niterations=8, run_id="smoke-watchdog", seed=5, verbosity=0))
+    raise SystemExit("search finished — watchdog never fired")
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--watchdog-child":
+        _watchdog_child(sys.argv[2])
+        return 0
+    out_base = sys.argv[1] if len(sys.argv) > 1 else "/tmp/sr_pulse_smoke"
+    scenarios = [
+        ("anomaly+capture+bundle", scenario_anomaly_capture),
+        ("watchdog-trip-bundle", scenario_watchdog_bundle),
+    ]
+    for name, fn in scenarios:
+        try:
+            fn(out_base)
+        except Exception as e:  # noqa: BLE001 - report and fail the job
+            print(f"FAIL [{name}]: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"OK   [{name}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
