@@ -37,7 +37,9 @@ from ..telemetry.hub import (
     RecorderSink,
     Telemetry,
 )
-from ..telemetry.spans import host_span, step_span
+from ..ledger.context import mint_run_trace
+from ..ledger.ledger import CostLedger
+from ..telemetry.spans import host_span, set_span_observer, step_span
 from ..utils.progress import ProgressBar
 from ..utils.recorder import Recorder
 from .hall_of_fame import (
@@ -131,6 +133,16 @@ class RuntimeOptions:
     pulse_trace_on: bool = False
     pulse_trace_iterations: int = 2
     pulse_trace_budget: int = 2
+    # graftledger (docs/OBSERVABILITY.md): per-request cost attribution
+    # + causal tracing. ``trace`` is the request's TraceContext —
+    # minted (and journaled) by SearchServer.submit(); None mints a
+    # deterministic context from run_id, so every graftscope.v2 event
+    # carries trace ids either way. ``ledger`` writes the
+    # graftledger.v1 per-phase cost account to <run_dir>/ledger.jsonl
+    # (save_to_file runs only). Host-side and bit-neutral, pinned by
+    # the on/off A/B in tests/test_ledger.py.
+    trace: Optional[Any] = None  # ledger.context.TraceContext
+    ledger: bool = True
 
 
 @dataclasses.dataclass
@@ -894,6 +906,16 @@ def equation_search(
     stop_reason = None
     cycles_remaining = total_cycles - start_iter * options.ncycles_per_iteration
 
+    # ---- graftledger causal context (ledger/, docs/OBSERVABILITY.md) --
+    # A served request threads its journaled root TraceContext in
+    # through RuntimeOptions; the search runs under a deterministic
+    # child span of it. Plain searches mint a root from run_id. Either
+    # way every graftscope.v2 event the hub emits carries the ids.
+    search_trace = (
+        ropt.trace.child("search") if ropt.trace is not None
+        else mint_run_trace(ropt.run_id)
+    )
+
     # ---- graftscope telemetry hub (telemetry/hub.py) ----
     # One object owns every per-iteration consumer — the SRLogger, the
     # genealogy Recorder, the ProgressBar — as registered sinks, plus
@@ -904,6 +926,7 @@ def equation_search(
         out_dir=out_dir,
         niterations=ropt.niterations,
         nout=len(datasets),
+        trace=search_trace,
         engine_info=[
             {
                 "output": j + 1,
@@ -977,6 +1000,25 @@ def equation_search(
             on_anomaly=(pulse_cap.arm if pulse_cap is not None else None),
         ))
 
+    # ---- graftledger cost account (ledger/ledger.py) ----
+    # One account segment per search attempt, appended to
+    # <run_dir>/ledger.jsonl: device/host seconds per iteration,
+    # compile seconds (jax.monitoring diffs), the timed host-phase
+    # spans (thread-local observer — concurrent serve workers each see
+    # only their own search), and checkpoint bytes. Read-only over
+    # values the loop already materialized; bit-neutral.
+    ledger_sink = None
+    if ropt.ledger and is_rank0:
+        ledger_sink = CostLedger(
+            (os.path.join(out_dir, "ledger.jsonl")
+             if out_dir is not None else None),
+            run_id=ropt.run_id,
+            trace=search_trace,
+            hub=hub,
+        )
+        hub.add_sink(ledger_sink)
+        set_span_observer(ledger_sink.note_phase)
+
     # ---- graftshield supervision (shield/ package, docs/ROBUSTNESS.md) --
     # Preemption guard: SIGTERM/SIGINT set a flag the budget poll reads;
     # the loop then stops at the iteration boundary with
@@ -1041,6 +1083,16 @@ def equation_search(
             nfeatures=[ds.nfeatures for ds in datasets],
             iterations_done=it,
         )
+
+    def _note_checkpoint_bytes(saved_path: Optional[str]) -> None:
+        # graftledger: bytes_checkpointed per request (wall subtree —
+        # re-saves after a resume make the count schedule-dependent)
+        if ledger_sink is None or not saved_path:
+            return
+        try:
+            ledger_sink.note_checkpoint(os.path.getsize(saved_path))
+        except OSError:
+            pass
 
     # Interactive quit ('q' / ctrl-d on stdin; StdinReader analogue).
     from ..utils.stdin_quit import StdinQuitWatcher
@@ -1195,8 +1247,10 @@ def equation_search(
                 pulse_cap.maybe_start(it + 1)
             # sr:iteration span: one profiler step per search iteration,
             # so a perfetto/xplane capture lines up device work with
-            # iterations.
-            with step_span(it + 1):
+            # iterations; the graftledger ids make the capture joinable
+            # with the JSONL streams and the exported timeline.
+            with step_span(it + 1, trace_id=search_trace.trace_id,
+                           span_id=search_trace.span_id):
                 for j, (engine, data) in enumerate(zip(engines, datas)):
                     def one(j=j, engine=engine, data=data):
                         dispatch_count["n"] += 1
@@ -1305,7 +1359,7 @@ def equation_search(
                     # iteration — the population pytree is much larger
                     # than the HoF CSVs; the final/stopping state is
                     # written once after the loop.
-                    ckpt.save(_checkpoint_state())
+                    _note_checkpoint_bytes(ckpt.save(_checkpoint_state()))
                     last_ckpt_it = it
 
             # One hub dispatch replaces the old ad-hoc recorder/logger/bar
@@ -1381,7 +1435,7 @@ def equation_search(
             # the SIGTERM handler deferred to the iteration boundary).
             # Skipped only when this exact iteration already saved (it
             # would duplicate the state and burn a rolling generation).
-            ckpt.save(_checkpoint_state())
+            _note_checkpoint_bytes(ckpt.save(_checkpoint_state()))
         if ckpt is not None and it > 0 and stop_reason == "preempted":
             hub.fault(
                 "emergency_checkpoint", iteration=it,
@@ -1419,6 +1473,11 @@ def equation_search(
         guard.uninstall()
         if watchdog is not None:
             watchdog.stop()
+        if ledger_sink is not None:
+            # clear this thread's span observer — a serve worker thread
+            # runs many searches back to back, and the next one must
+            # not bill its phases to this request's ledger
+            set_span_observer(None)
 
     if ropt.verbosity >= 1:
         for j, (hof, ds) in enumerate(zip(hofs, datasets)):

@@ -73,6 +73,7 @@ def run_load(
     """
     import numpy as np
 
+    from ..ledger.rollup import load_rollup
     from ..serve.admission import ServerSaturated
     from ..serve.server import SearchServer
     from ..telemetry.report import summarize
@@ -144,6 +145,30 @@ def run_load(
         cache_hit_rate = (summary.get("serve", {})
                           .get("cache", {}).get("hit_rate"))
 
+    # per-tenant cost attribution: the server's graftledger rollup
+    # (written on every request completion) gives each request's
+    # device-seconds; the max/min spread is the fairness headline — a
+    # storm of IDENTICAL searches should cost every tenant about the
+    # same, so a wide spread means scheduling skew, not workload skew
+    ledger: Optional[Dict[str, Any]] = None
+    rollup = load_rollup(root)
+    if rollup and rollup.get("requests"):
+        per_req = {
+            rid: round(float(acct.get("device_s", 0.0)), 6)
+            for rid, acct in sorted(rollup["requests"].items())
+        }
+        costs = [c for c in per_req.values() if c > 0.0]
+        spread = (round(max(costs) / min(costs), 3)
+                  if costs and min(costs) > 0.0 else None)
+        totals = rollup.get("totals", {})
+        ledger = {
+            "requests": len(per_req),
+            "device_seconds": per_req,
+            "total_device_s": round(float(totals.get("device_s", 0.0)), 6),
+            "total_evals": totals.get("num_evals"),
+            "fairness_spread": spread,  # max/min per-request device_s
+        }
+
     report = {
         "schema": LOAD_SCHEMA,
         "t": time.time(),
@@ -170,6 +195,7 @@ def run_load(
             "max": max(poll_lat) if poll_lat else None,
         },
         "cache_hit_rate": cache_hit_rate,
+        "ledger": ledger,
         "serve_telemetry": serve_stream,
     }
     p99 = report["poll_latency_s"]["p99"]
@@ -179,6 +205,11 @@ def run_load(
         f"p99 poll {'-' if p99 is None else format(p99, '.4f')}s, "
         f"cache hit rate "
         f"{'-' if cache_hit_rate is None else format(cache_hit_rate, '.0%')}")
+    if ledger is not None:
+        log(f"load: ledger {ledger['requests']} request(s), "
+            f"{ledger['total_device_s']:.3f} device-s total, "
+            f"fairness spread (max/min device-s) "
+            f"{'-' if ledger['fairness_spread'] is None else ledger['fairness_spread']}")
     # a storm where admission wedged and some requests were NEVER
     # accepted (the retry loop ran out its deadline) must fail too —
     # submitted==0 with zero failures is not a healthy server

@@ -65,5 +65,40 @@ class PromText:
         self._sample(name, "counter", help_text, value, labels)
         return self
 
+    def histogram(self, name: str, le_bounds, bucket_counts, sum_value,
+                  help_text: str = "",
+                  labels: Optional[Dict[str, str]] = None) -> "PromText":
+        """One pre-bucketed histogram sample set (graftledger's
+        log-bucketed iteration latencies): ``bucket_counts`` has one
+        extra slot past ``le_bounds`` for the +Inf bucket; buckets
+        render CUMULATIVE per the exposition format, plus the
+        ``_count`` / ``_sum`` series."""
+        family = f"{self.prefix}_{name}" if self.prefix else name
+        if family not in self._seen_meta:
+            self._seen_meta[family] = "histogram"
+            self._lines.append(f"# HELP {family} {_escape_help(help_text)}")
+            self._lines.append(f"# TYPE {family} histogram")
+        base = dict(labels or {})
+
+        def label_str(extra: Dict[str, str]) -> str:
+            pairs = ",".join(
+                f'{k}="{_escape_label(v)}"'
+                for k, v in sorted({**base, **extra}.items()))
+            return "{" + pairs + "}" if pairs else ""
+
+        cum = 0
+        for le, n in zip(le_bounds, bucket_counts):
+            cum += int(n)
+            self._lines.append(
+                f"{family}_bucket{label_str({'le': repr(float(le))})} {cum}")
+        cum += int(bucket_counts[len(le_bounds)]) \
+            if len(bucket_counts) > len(le_bounds) else 0
+        self._lines.append(
+            f"{family}_bucket{label_str({'le': '+Inf'})} {cum}")
+        self._lines.append(f"{family}_count{label_str({})} {cum}")
+        self._lines.append(
+            f"{family}_sum{label_str({})} {float(sum_value)!r}")
+        return self
+
     def render(self) -> str:
         return "\n".join(self._lines) + ("\n" if self._lines else "")

@@ -121,6 +121,18 @@ class FlightRecorder:
             })
 
     # ------------------------------------------------------------------
+    def _trace_dict(self) -> Optional[Dict[str, Any]]:
+        # graftledger trace context rides in from the hub (same ids the
+        # JSONL stream stamps); deterministic given the request/run, so
+        # it lives OUTSIDE wall and survives into the fingerprint
+        trace = getattr(self.hub, "trace", None)
+        if trace is None:
+            return None
+        try:
+            return trace.to_dict()
+        except Exception:
+            return None
+
     def snapshot(self, trigger: Dict[str, Any]) -> Dict[str, Any]:
         """The bundle dict (see module docstring for the layout)."""
         det_iters = [d for d, _ in self._iters]
@@ -139,6 +151,7 @@ class FlightRecorder:
         return {
             "schema": BUNDLE_SCHEMA,
             "run_id": self.run_id,
+            "trace": self._trace_dict(),
             "ring_capacity": self.capacity,
             "dump_seq": self.dumps + 1,
             "trigger": {k: trig[k] for k in sorted(trig)

@@ -22,9 +22,51 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
-__all__ = ["MetricsServer", "CONTENT_TYPE"]
+__all__ = ["MetricsServer", "CONTENT_TYPE", "render_ledger_metrics"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def render_ledger_metrics(p, rollup: Optional[dict]) -> None:
+    """Append the graftledger per-tenant cost section to a ``PromText``
+    builder from a ``graftledger.rollup.v1`` document (ledger/rollup.py;
+    None — no rollup written yet — appends nothing).
+
+    One label set per request the root has ever completed: attribution
+    is the point, and a serve root's request count is bounded by its
+    lifetime, not its concurrency — operators with long-lived roots
+    should scrape the rollup file instead of relying on these families
+    staying small."""
+    if not rollup:
+        return
+    for rid, acct in sorted(rollup.get("requests", {}).items()):
+        labels = {"request": rid}
+        p.counter("request_device_seconds_total", acct.get("device_s", 0.0),
+                  "Ledger-attributed device seconds per request", labels)
+        p.counter("request_host_seconds_total", acct.get("host_s", 0.0),
+                  "Ledger-attributed host bookkeeping seconds", labels)
+        p.counter("request_compile_seconds_total",
+                  acct.get("compile_s", 0.0),
+                  "Ledger-attributed trace+compile seconds", labels)
+        p.counter("request_ledger_evals_total", acct.get("num_evals", 0.0),
+                  "Final cumulative expression evaluations", labels)
+        p.counter("request_checkpoint_bytes_total",
+                  acct.get("checkpoint_bytes", 0),
+                  "Bytes of full-state checkpoints written", labels)
+        hist = acct.get("iteration_latency") or {}
+        le = hist.get("le") or []
+        counts = hist.get("counts") or []
+        if le and len(counts) == len(le) + 1:
+            p.histogram(
+                "request_iteration_latency_seconds", le, counts,
+                acct.get("device_s", 0.0) + acct.get("host_s", 0.0),
+                "Per-iteration device+host latency (log-bucketed)",
+                labels)
+    totals = rollup.get("totals", {})
+    p.counter("ledger_device_seconds_total", totals.get("device_s", 0.0),
+              "Ledger-attributed device seconds, all requests")
+    p.counter("ledger_evals_total", totals.get("num_evals", 0.0),
+              "Cumulative expression evaluations, all requests")
 
 
 class MetricsServer:

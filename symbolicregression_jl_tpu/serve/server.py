@@ -47,6 +47,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..ledger.context import TraceContext, mint_trace
+from ..ledger.rollup import load_rollup, write_rollup
 from ..shield.faults import active_serve_injector
 from ..shield.watchdog import Watchdog, WatchdogTimeout
 from .admission import AdmissionController, ServerSaturated, shape_bucket
@@ -96,6 +98,11 @@ class SearchRequest:
     # search (RuntimeOptions.pulse_trace_on); journaled so a replayed
     # request still honors it
     pulse_trace: bool = False
+    # graftledger: the request's root TraceContext, minted at submit()
+    # from request content (ledger/context.py) and journaled — a
+    # replayed request reads the ORIGINAL ids back verbatim, so
+    # kill-restart-replay reconstructs the identical causal tree.
+    trace: Optional[TraceContext] = None
 
     def to_detail(self) -> Dict[str, Any]:
         return {
@@ -110,6 +117,7 @@ class SearchRequest:
             "bucket": list(self.bucket),
             "index": int(self.index),
             "pulse_trace": bool(self.pulse_trace),
+            "trace": self.trace.to_dict() if self.trace else None,
         }
 
     @staticmethod
@@ -127,6 +135,13 @@ class SearchRequest:
             bucket=tuple(d.get("bucket") or (0, 0, 0)),
             index=int(d.get("index", 0)),
             pulse_trace=bool(d.get("pulse_trace", False)),
+            # pre-graftledger journals carry no trace: re-mint from the
+            # same content the original submit would have hashed, so
+            # old roots replay with stable (and still deterministic) ids
+            trace=(TraceContext.from_dict(d.get("trace"))
+                   or mint_trace(request_id,
+                                 seed=int(d["seed"]),
+                                 niterations=int(d["niterations"]))),
         )
 
 
@@ -335,6 +350,7 @@ class SearchServer:
             if ev == "submit":
                 try:
                     req = SearchRequest.from_detail(rid, rec["detail"])
+                    self.log.trace_of[rid] = req.trace
                 except Exception as e:  # noqa: BLE001 - poison record
                     # a digest-valid record whose payload cannot be
                     # reconstructed must not brick recovery of every
@@ -380,7 +396,7 @@ class SearchServer:
             self._qseq += 1
             heapq.heappush(self._queue, (priority, self._qseq, rid))
             self.log.serve(
-                "replay", rid, resumed=r.resumed,
+                "replay", rid, trace=r.request.trace, resumed=r.resumed,
                 bucket=list(r.request.bucket),
             )
 
@@ -488,6 +504,12 @@ class SearchServer:
                     sample_rows=decision.sample_rows,
                     bucket=decision.bucket, index=self._accepted,
                     pulse_trace=bool(pulse_trace),
+                    # graftledger root span: minted from request content
+                    # (never the root path), journaled with the submit
+                    # record — replay and cross-root A/B runs agree on
+                    # every id
+                    trace=mint_trace(rid, seed=int(seed),
+                                     niterations=int(niterations)),
                 )
                 # reserve the id (collision checks see it) but do NOT
                 # enqueue yet: no worker may journal a dependent
@@ -515,8 +537,9 @@ class SearchServer:
         # on the heap a worker may log "start" immediately, and the
         # per-request view's lifecycle ordering (accept -> start) must
         # hold in the stream. Still outside the server lock.
+        self.log.trace_of[rid] = req.trace
         self.log.serve(
-            "accept", rid, bucket=list(decision.bucket),
+            "accept", rid, trace=req.trace, bucket=list(decision.bucket),
             priority=decision.priority,
             sample_rows=decision.sample_rows,
             level=decision.level, queue_depth=self.admission.depth,
@@ -626,6 +649,13 @@ class SearchServer:
                     "Cumulative expression evaluations", labels)
             p.gauge("request_evals_per_sec", prog["evals_per_sec"],
                     "Cumulative evaluation rate", labels)
+        # graftledger per-tenant cost attribution: device/host/compile
+        # seconds, evals, checkpoint bytes, and the log-bucketed
+        # iteration-latency histogram per request, from the rollup the
+        # completion path maintains (ledger/rollup.py)
+        from .metrics import render_ledger_metrics
+
+        render_ledger_metrics(p, load_rollup(self.root))
         return p.render()
 
     # ------------------------------------------------------------------
@@ -785,7 +815,9 @@ class SearchServer:
     # ------------------------------------------------------------------
     def _on_cache_event(self, kind: str, detail: Dict[str, Any]) -> None:
         rid = getattr(self._cache_tls, "request_id", "") or ""
-        self.log.serve(kind, rid, **detail)
+        rec = self._records.get(rid)
+        self.log.serve(kind, rid,
+                       trace=rec.request.trace if rec else None, **detail)
 
     def _request_dir(self, rid: str) -> str:
         return os.path.join(self.root, "requests", rid)
@@ -800,7 +832,8 @@ class SearchServer:
         except OSError as e:
             self.log.fault("journal_write_failed", request_id=rid,
                            event="cancel", error=str(e)[:200])
-        self.log.serve("cancel", rid, reason=rec.cancel_reason, where=where)
+        self.log.serve("cancel", rid, trace=rec.request.trace,
+                       reason=rec.cancel_reason, where=where)
 
     def _finish(self, rec: _RequestRecord, state: str, *, result=None,
                 error=None, journal_event: Optional[str] = None) -> None:
@@ -844,9 +877,14 @@ class SearchServer:
             )
         self.log.serve(
             {"cancelled": "cancel"}.get(state, state),
-            rec.request.request_id,
+            rec.request.request_id, trace=rec.request.trace,
             error=error, reason=rec.cancel_reason,
         )
+        # graftledger rollup: rebuild the per-tenant view from the
+        # per-request ledger files on every completion. A full rewrite,
+        # so a crash between completions loses nothing — the files are
+        # the source of truth. /metrics and `bench load` read it.
+        write_rollup(self.root)
 
     def _run_request(self, rec: _RequestRecord) -> None:
         from ..api.search import RuntimeOptions, equation_search
@@ -864,7 +902,7 @@ class SearchServer:
             # from its checkpoints.
             self.log.fault("journal_write_failed", request_id=rid,
                            event="start", error=str(e)[:200])
-        self.log.serve("start", rid, resumed=rec.resumed)
+        self.log.serve("start", rid, trace=req.trace, resumed=rec.resumed)
         if self._injector is not None:
             self._injector.on_request_start(req.index, rid)
 
@@ -918,6 +956,10 @@ class SearchServer:
             stop_hook=stop_hook,
             logger=_InjectorProbe(self, rec), log_every_n=1,
             pulse_trace_on=bool(req.pulse_trace),
+            # graftledger: the search runs under a child span of the
+            # journaled request root — its hub stamps the same trace_id
+            # on every event of the request's own graftscope stream
+            trace=req.trace,
         )
         # Hang backstop: the soft deadline above stops at an iteration
         # boundary; a dispatch that never reaches one trips the
@@ -997,7 +1039,8 @@ class SearchServer:
             if terminal:
                 self._finish(rec, "cancelled", journal_event="cancel")
             else:
-                self.log.serve("interrupted", rid, iterations=iters)
+                self.log.serve("interrupted", rid, trace=req.trace,
+                               iterations=iters)
             return
         hofs = hof if isinstance(hof, list) else [hof]
         result = {

@@ -19,6 +19,7 @@ doing and what is pacing it", answerable on every run):
 
       python -m symbolicregression_jl_tpu.telemetry report run.jsonl
       python -m symbolicregression_jl_tpu.telemetry validate run.jsonl
+      python -m symbolicregression_jl_tpu.telemetry timeline root --out t.json
 
 Enable with ``Options(telemetry=True)``; see docs/OBSERVABILITY.md.
 """
@@ -30,7 +31,12 @@ from .counters import (
     empty_iteration_telemetry,
 )
 from .hub import IterationContext, Telemetry
-from .schema import SCHEMA_VERSION, validate_event, validate_lines
+from .schema import (
+    SCHEMA_VERSION,
+    SCHEMA_VERSIONS,
+    validate_event,
+    validate_lines,
+)
 
 __all__ = [
     "CycleTelemetry",
@@ -38,6 +44,7 @@ __all__ = [
     "IterationContext",
     "Telemetry",
     "SCHEMA_VERSION",
+    "SCHEMA_VERSIONS",
     "empty_cycle_telemetry",
     "empty_iteration_telemetry",
     "validate_event",

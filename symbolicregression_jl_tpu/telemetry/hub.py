@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..core.options import MUTATION_KINDS
+from ..ledger.context import TraceContext, mint_run_trace
 from .schema import SCHEMA_VERSION
 from .spans import host_span, set_profiler_warning_hook
 
@@ -73,6 +74,12 @@ class _CompileEventCounter:
         self.traces = 0
         self.backend_compiles = 0
         self.transfer_guard_hits = 0
+        # graftledger: the compile-seconds the same events carry — the
+        # cost ledger diffs these the way the anomaly detector diffs the
+        # counts (wall-clock, so ledger accounts keep them out of the
+        # deterministic view)
+        self.trace_secs = 0.0
+        self.backend_compile_secs = 0.0
         self._active = False
 
     def _on_duration(self, name: str, secs: float, **kw) -> None:
@@ -80,10 +87,12 @@ class _CompileEventCounter:
             return
         if name.endswith("jaxpr_trace_duration"):
             self.traces += 1
+            self.trace_secs += float(secs or 0.0)
         elif name.endswith("backend_compile_duration") or name.endswith(
             "backend_compile_time"
         ):
             self.backend_compiles += 1
+            self.backend_compile_secs += float(secs or 0.0)
         elif "transfer_guard" in name:  # emitted by some jax versions only
             self.transfer_guard_hits += 1
 
@@ -111,6 +120,15 @@ class _CompileEventCounter:
             "traces": self.traces,
             "backend_compiles": self.backend_compiles,
             "transfer_guard_hits": self.transfer_guard_hits,
+        }
+
+    def seconds_snapshot(self) -> Dict[str, float]:
+        """Cumulative compile wall-seconds (kept out of :meth:`snapshot`
+        so count consumers — recompiles_total, the anomaly detector —
+        never see float fields)."""
+        return {
+            "trace_s": self.trace_secs,
+            "backend_compile_s": self.backend_compile_secs,
         }
 
 
@@ -176,11 +194,17 @@ class Telemetry:
         niterations: int,
         nout: int,
         engine_info: Optional[List[Dict[str, Any]]] = None,
+        trace: Optional[TraceContext] = None,
     ) -> None:
         import jax
 
         self.options = options
         self.run_id = run_id
+        # graftledger causal context: served searches thread the child
+        # span of their request's journaled root through RuntimeOptions;
+        # plain searches fall back to a deterministic run_id mint — so
+        # EVERY event this hub emits carries a trace (graftscope.v2).
+        self.trace = trace if trace is not None else mint_run_trace(run_id)
         self.interval = max(int(getattr(options, "telemetry_interval", 1)), 1)
         self._sinks: List[Any] = []
         self._compiles = _CompileEventCounter()
@@ -358,13 +382,18 @@ class Telemetry:
         per-iteration recompile signal)."""
         return self._compiles.snapshot()
 
+    def compile_seconds_snapshot(self) -> Dict[str, float]:
+        """Cumulative compile wall-seconds (the cost ledger diffs these
+        for its per-iteration compile_s attribution)."""
+        return self._compiles.seconds_snapshot()
+
     def _emit(self, obj: Dict[str, Any]) -> None:
         # run_id on EVERY event (not just run_start) so concatenated or
         # multi-tenant streams stay attributable: `telemetry report`
         # groups records by run_id/request_id (docs/SERVING.md).
         obj = {
             "schema": SCHEMA_VERSION, "t": time.time(),
-            "run_id": self.run_id, **obj,
+            "run_id": self.run_id, "trace": self.trace.to_dict(), **obj,
         }
         with open(self.path, "a") as f:
             f.write(json.dumps(obj) + "\n")

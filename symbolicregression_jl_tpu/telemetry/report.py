@@ -43,30 +43,56 @@ _SERVE_LIFECYCLE = ("accept", "start", "interrupted", "done", "cancel",
 
 
 def _request_key(e: dict) -> Optional[str]:
-    """The grouping key of one event for the per-request view: an
-    explicit request_id (serve events; fault events emitted by the serve
-    layer carry it in detail), else the emitting search's run_id."""
+    """The human-readable grouping key of one event for the per-request
+    view: an explicit request_id (serve events; fault events emitted by
+    the serve layer carry it in detail), else the emitting search's
+    run_id."""
     rid = e.get("request_id")
     if not rid and isinstance(e.get("detail"), dict):
         rid = e["detail"].get("request_id")
     return (rid or e.get("run_id")) or None
 
 
+def _trace_id(e: dict) -> Optional[str]:
+    trace = e.get("trace")
+    if isinstance(trace, dict):
+        tid = trace.get("trace_id")
+        return tid if isinstance(tid, str) else None
+    return None
+
+
 def summarize_requests(events: List[dict]) -> Dict[str, Any]:
-    """Group graftscope.v1 records by run_id/request_id — the
-    per-request view of a multi-tenant (graftserve) or concatenated
-    stream. Events without either id (pre-run_id single-search files)
-    are ignored."""
+    """Group graftscope records into the per-request view of a
+    multi-tenant (graftserve) or concatenated stream.
+
+    Events group by graftledger ``trace_id`` when present (v2), falling
+    back to request_id/run_id — so a mixed v1+v2 directory (old runs
+    next to new ones) still groups every event, and two streams of one
+    request join on the causal id even when their human ids differ.
+    Returned groups stay keyed by the human-readable id (the first
+    request_id/run_id seen for each trace); events with neither id are
+    ignored."""
+    # pass 1: canonical human key per trace_id (first seen wins)
+    canon: Dict[str, str] = {}
+    for e in events:
+        tid = _trace_id(e)
+        if tid is None or tid in canon:
+            continue
+        canon[tid] = _request_key(e) or tid
     groups: Dict[str, Dict[str, Any]] = {}
     for e in events:
-        key = _request_key(e)
+        tid = _trace_id(e)
+        key = canon[tid] if tid is not None else _request_key(e)
         if key is None:
             continue
         g = groups.setdefault(key, {
             "events": 0, "iterations": 0, "num_evals": None,
             "faults": {}, "serve": {}, "state": None,
             "first_t": None, "last_t": None, "stop_reason": None,
+            "trace_id": None,
         })
+        if tid is not None and g["trace_id"] is None:
+            g["trace_id"] = tid
         g["events"] += 1
         t = e.get("t")
         if isinstance(t, (int, float)):
@@ -506,7 +532,9 @@ def format_report(summary: Dict[str, Any]) -> str:
             )
     reqs = summary.get("requests")
     if reqs:
-        lines.append(f"requests: {len(reqs)} (grouped by request_id/run_id)")
+        lines.append(
+            f"requests: {len(reqs)} "
+            "(grouped by trace_id, else request_id/run_id)")
         for rid in sorted(reqs):
             g = reqs[rid]
             bits = []
@@ -550,6 +578,10 @@ commands:
   validate <run.jsonl>             check every line against graftscope.v1
   tail <run.jsonl> [--interval S]  follow a live stream with a refreshing
        [--once]                    single-screen summary (--once: one shot)
+  timeline <root> --out <t.json>   merge a serve root's journal, request
+                                   streams and cost ledgers into one
+                                   Chrome trace-event file (Perfetto /
+                                   chrome://tracing openable)
 
 report tolerates a torn final line (the crash artifact of a killed
 writer): it is skipped and counted on stderr, like journal replay.
@@ -615,5 +647,34 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .tail import main as tail_main
 
         return tail_main(rest)
+    if cmd == "timeline":
+        from ..ledger.timeline import write_timeline
+
+        out = None
+        paths = []
+        i = 0
+        while i < len(rest):
+            if rest[i] == "--out":
+                if i + 1 >= len(rest):
+                    print(_USAGE, end="", file=sys.stderr)
+                    return 2
+                out = rest[i + 1]
+                i += 2
+            elif rest[i].startswith("-"):
+                print(_USAGE, end="", file=sys.stderr)
+                return 2
+            else:
+                paths.append(rest[i])
+                i += 1
+        if len(paths) != 1 or not out:
+            print(_USAGE, end="", file=sys.stderr)
+            return 2
+        doc = write_timeline(paths[0], out)
+        n = len(doc.get("traceEvents", []))
+        if n == 0:
+            print(f"{paths[0]}: no telemetry found", file=sys.stderr)
+            return 1
+        print(f"{out}: {n} trace events")
+        return 0
     print(_USAGE, end="", file=sys.stderr)
     return 2

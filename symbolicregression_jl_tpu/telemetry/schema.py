@@ -1,14 +1,21 @@
-"""graftscope JSONL event schema (version 1) + hand-rolled validator.
+"""graftscope JSONL event schema (version 2) + hand-rolled validator.
 
 Every line the `Telemetry` hub emits is one JSON object with at least::
 
-    {"schema": "graftscope.v1", "event": <type>, "t": <unix seconds>}
+    {"schema": "graftscope.v2", "event": <type>, "t": <unix seconds>}
 
 Event types and their required fields are listed in :data:`EVENT_SPECS`.
 No external jsonschema dependency: the validator is a small table-driven
 checker (CI validates every emitted line with it, and the report CLI
 refuses files that don't validate — see docs/OBSERVABILITY.md for the
 full field semantics).
+
+v2 adds the optional graftledger ``trace`` field — a
+``{"trace_id", "span_id", "parent_id"}`` causal-context object
+(ledger/context.py) the hub stamps onto every event it emits. The
+validator type-checks ``trace`` when present but does not require it:
+pre-v2 streams (schema ``graftscope.v1``) still validate unchanged, and
+synthetic v2 events without a trace (bench fixtures) stay valid too.
 """
 
 from __future__ import annotations
@@ -16,10 +23,15 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-__all__ = ["SCHEMA_VERSION", "EVENT_SPECS", "validate_event",
-           "validate_lines", "load_events", "load_events_tolerant"]
+__all__ = ["SCHEMA_VERSION", "SCHEMA_VERSIONS", "EVENT_SPECS",
+           "validate_event", "validate_lines", "load_events",
+           "load_events_tolerant"]
 
-SCHEMA_VERSION = "graftscope.v1"
+SCHEMA_VERSION = "graftscope.v2"
+
+# every schema version the validator accepts, oldest first; v1 events
+# (no trace field) remain valid forever — the bump is purely additive
+SCHEMA_VERSIONS = ("graftscope.v1", "graftscope.v2")
 
 _NUM = (int, float)
 
@@ -121,6 +133,14 @@ _OUTPUT_FIELDS: Dict[str, Any] = {
     "complexity_hist": (list, type(None)),
 }
 
+# required keys inside the optional top-level `trace` field (v2,
+# ledger/context.py): parent_id is nullable (None at the tree root)
+_TRACE_FIELDS: Dict[str, Any] = {
+    "trace_id": str,
+    "span_id": str,
+    "parent_id": (str, type(None)),
+}
+
 # required keys inside iteration.outputs[*].counters when present
 _COUNTER_FIELDS: Dict[str, Any] = {
     "proposed": dict,
@@ -167,10 +187,18 @@ def validate_event(obj: Any) -> List[str]:
     errors: List[str] = []
     if not isinstance(obj, dict):
         return [f"event is {type(obj).__name__}, expected object"]
-    if obj.get("schema") != SCHEMA_VERSION:
+    if obj.get("schema") not in SCHEMA_VERSIONS:
         errors.append(
-            f"schema is {obj.get('schema')!r}, expected {SCHEMA_VERSION!r}"
+            f"schema is {obj.get('schema')!r}, expected one of "
+            f"{SCHEMA_VERSIONS!r}"
         )
+    trace = obj.get("trace")
+    if trace is not None:
+        if not isinstance(trace, dict):
+            errors.append(
+                f"trace is {type(trace).__name__}, expected object")
+        else:
+            _check_fields(trace, _TRACE_FIELDS, "trace", errors)
     ev = obj.get("event")
     if ev not in EVENT_SPECS:
         errors.append(

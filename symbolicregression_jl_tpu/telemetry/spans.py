@@ -29,9 +29,54 @@ event, kind ``profiler_unusable``); later failures stay quiet.
 from __future__ import annotations
 
 import contextlib
+import threading
+import time
 from typing import Callable, Optional
 
-__all__ = ["step_span", "host_span", "set_profiler_warning_hook"]
+__all__ = ["step_span", "host_span", "set_profiler_warning_hook",
+           "set_span_observer"]
+
+# graftledger host-phase observer: a thread-local callback receiving
+# (name, seconds) for every completed host span on THIS thread. Thread-
+# local because a SearchServer runs concurrent searches on concurrent
+# worker threads — each search's ledger must see only its own phases.
+# When no observer is registered (ledger off, or any thread that never
+# set one) host_span returns the raw annotation unchanged: zero new
+# work on the hot path.
+_observer = threading.local()
+
+
+def set_span_observer(
+        fn: Optional[Callable[[str, float], None]]) -> None:
+    """Register (or clear, with None) this thread's host-span observer.
+    The cost ledger registers one for the search's lifetime and clears
+    it in the loop's ``finally``."""
+    _observer.fn = fn
+
+
+class _TimedSpan:
+    """Wraps a profiler annotation with a wall-clock timing report."""
+
+    __slots__ = ("name", "inner", "report", "_t0")
+
+    def __init__(self, name: str, inner,
+                 report: Callable[[str, float], None]) -> None:
+        self.name = name
+        self.inner = inner
+        self.report = report
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self.inner.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        result = self.inner.__exit__(*exc)
+        try:
+            self.report(self.name, time.perf_counter() - self._t0)
+        except Exception:  # observation must never outcrash the span
+            pass
+        return result
 
 # one-time-per-process profiler-unusable warning plumbing: the latest
 # constructed Telemetry hub owns the hook (multiple hubs in one process
@@ -63,23 +108,40 @@ def _note_profiler_unusable(err: BaseException) -> None:
         pass
 
 
-def step_span(step_num: int):
-    """Profiler step annotation for one search iteration."""
+def step_span(step_num: int, *, trace_id: Optional[str] = None,
+              span_id: Optional[str] = None):
+    """Profiler step annotation for one search iteration.
+
+    When graftledger trace context is threaded in, the annotation
+    carries ``trace_id``/``span_id`` attributes so an on-device
+    profiler capture (perfetto/xplane) correlates with the host
+    timeline and the JSONL streams by id, not by eyeballing clocks.
+    """
     try:
         import jax.profiler as _prof
 
-        return _prof.StepTraceAnnotation("sr:iteration", step_num=step_num)
+        attrs = {"step_num": step_num}
+        if trace_id is not None:
+            attrs["trace_id"] = trace_id
+        if span_id is not None:
+            attrs["span_id"] = span_id
+        return _prof.StepTraceAnnotation("sr:iteration", **attrs)
     except Exception as e:  # pragma: no cover - profiler unavailable
         _note_profiler_unusable(e)
         return contextlib.nullcontext()
 
 
 def host_span(name: str):
-    """Named host-phase span (``sr:host:<name>``)."""
+    """Named host-phase span (``sr:host:<name>``); timed and reported
+    to this thread's ledger observer when one is registered."""
     try:
         import jax.profiler as _prof
 
-        return _prof.TraceAnnotation(f"sr:host:{name}")
+        span = _prof.TraceAnnotation(f"sr:host:{name}")
     except Exception as e:  # pragma: no cover - profiler unavailable
         _note_profiler_unusable(e)
-        return contextlib.nullcontext()
+        span = contextlib.nullcontext()
+    fn = getattr(_observer, "fn", None)
+    if fn is None:
+        return span
+    return _TimedSpan(name, span, fn)

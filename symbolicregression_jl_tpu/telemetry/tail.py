@@ -128,19 +128,27 @@ class TailFollower:
         self.state = TailState()
         self._pos = 0
         self._buf = ""
+        self._ino: Optional[tuple] = None  # (st_dev, st_ino) of last poll
 
     def poll(self) -> int:
         """Fold newly-appended complete lines into the state; returns
-        how many events arrived. Missing file = 0 (writer not up yet);
-        a file that SHRANK is a new run over the same path — restart."""
+        how many events arrived. Missing file = 0 (writer not up yet).
+        A file that SHRANK (truncation) or whose identity changed
+        (rotation: rename-and-recreate swaps the inode, possibly with a
+        LARGER new file) is a new run over the same path — restart the
+        summary from byte 0 rather than silently mixing two runs or
+        stalling on a stale offset."""
         try:
-            size = os.path.getsize(self.path)
+            st = os.stat(self.path)
         except OSError:
             return 0
-        if size < self._pos:
+        ident = (st.st_dev, st.st_ino)
+        if st.st_size < self._pos or (
+                self._ino is not None and ident != self._ino):
             self.state = TailState()
             self._pos = 0
             self._buf = ""
+        self._ino = ident
         with open(self.path) as f:
             f.seek(self._pos)
             chunk = f.read()

@@ -1,0 +1,434 @@
+"""graftledger: deterministic trace minting, v2 schema round-trips,
+cost-account validation/folding, the rollup, the Chrome-trace timeline
+export, tail rotation handling, and the ledger on/off A/B bit-identity
+pin (docs/OBSERVABILITY.md "Cost attribution & tracing")."""
+
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.ledger import (
+    LATENCY_BUCKETS_S,
+    LEDGER_SCHEMA,
+    CostLedger,
+    TraceContext,
+    build_rollup,
+    build_timeline,
+    fold_accounts,
+    ledger_fingerprint,
+    load_accounts,
+    load_rollup,
+    mint_run_trace,
+    mint_trace,
+    validate_account,
+    validate_chrome_trace,
+    write_rollup,
+    write_timeline,
+)
+from symbolicregression_jl_tpu.ledger.ledger import bucket_latency
+from symbolicregression_jl_tpu.telemetry.schema import (
+    EVENT_SPECS,
+    SCHEMA_VERSION,
+    validate_event,
+)
+
+# ---------------------------------------------------------------------------
+# trace context minting
+# ---------------------------------------------------------------------------
+
+
+def test_mint_trace_is_deterministic_and_content_addressed():
+    a = mint_trace("req-1", seed=7, niterations=4)
+    b = mint_trace("req-1", seed=7, niterations=4)
+    assert a == b  # same content -> same ids (kill-restart-replay)
+    assert len(a.trace_id) == 32 and len(a.span_id) == 16
+    assert a.parent_id is None
+    # any content change moves the whole tree
+    assert mint_trace("req-2", seed=7, niterations=4).trace_id != a.trace_id
+    assert mint_trace("req-1", seed=8, niterations=4).trace_id != a.trace_id
+    assert mint_trace("req-1", seed=7, niterations=5).trace_id != a.trace_id
+
+
+def test_child_span_derivation_and_round_trip():
+    root = mint_trace("req-1", seed=7, niterations=4)
+    search = root.child("search")
+    assert search.trace_id == root.trace_id
+    assert search.parent_id == root.span_id
+    assert search.span_id != root.span_id
+    assert root.child("search") == search  # deterministic
+    assert root.child("replay") != search
+    assert TraceContext.from_dict(search.to_dict()) == search
+    assert TraceContext.from_dict(None) is None
+    assert TraceContext.from_dict({"trace_id": 1}) is None
+
+
+def test_run_trace_differs_from_request_trace():
+    assert (mint_run_trace("req-1").trace_id
+            != mint_trace("req-1", seed=0, niterations=1).trace_id)
+
+
+# ---------------------------------------------------------------------------
+# graftscope.v2 round-trip: every event kind carries the trace context
+# ---------------------------------------------------------------------------
+
+_MINIMAL_FIELDS = {
+    "run_start": dict(run_id="r", backend="cpu", n_devices=1, nout=1,
+                      niterations=2, telemetry_interval=1, options={},
+                      engines=[]),
+    "iteration": dict(iteration=1, num_evals=10.0, evals_per_sec=1.0,
+                      elapsed_s=1.0, device_s=0.5, host_s=0.1,
+                      host_fraction=0.1,
+                      recompiles={"traces": 0, "backend_compiles": 0},
+                      transfer_guard_hits=0, outputs=[]),
+    "run_end": dict(stop_reason="niterations", iterations=2,
+                    num_evals=20.0, elapsed_s=2.0, recompiles_total={}),
+    "fault": dict(kind="retry", iteration=1, detail={}),
+    "serve": dict(kind="accept", request_id="req-1", detail={}),
+    "mesh": dict(iteration=1, shards=2, detail={}),
+    "anomaly": dict(metric="evals_per_sec", iteration=1, detail={}),
+    "pulse": dict(kind="capture_armed", iteration=1, detail={}),
+}
+
+
+@pytest.mark.parametrize("kind", sorted(EVENT_SPECS))
+def test_every_event_kind_accepts_and_preserves_trace(kind):
+    trace = mint_trace("req-1", seed=7, niterations=4).child("search")
+    ev = {"schema": SCHEMA_VERSION, "event": kind, "t": 1.0,
+          "trace": trace.to_dict(), **_MINIMAL_FIELDS[kind]}
+    assert validate_event(ev) == []
+    back = json.loads(json.dumps(ev))  # JSONL wire round-trip
+    assert back["trace"] == trace.to_dict()
+    assert TraceContext.from_dict(back["trace"]) == trace
+
+
+@pytest.mark.parametrize("kind", sorted(EVENT_SPECS))
+def test_v1_events_without_trace_still_validate(kind):
+    ev = {"schema": "graftscope.v1", "event": kind, "t": 1.0,
+          **_MINIMAL_FIELDS[kind]}
+    assert validate_event(ev) == []
+
+
+def test_malformed_trace_rejected():
+    ev = {"schema": SCHEMA_VERSION, "event": "pulse", "t": 1.0,
+          "trace": {"trace_id": 5, "span_id": "x"},
+          **_MINIMAL_FIELDS["pulse"]}
+    errs = validate_event(ev)
+    assert any("trace" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# cost accounts: accumulate, validate, fold, fingerprint
+# ---------------------------------------------------------------------------
+
+
+def _iter_ctx(i, *, device_s=0.5, host_s=0.1):
+    return types.SimpleNamespace(
+        iteration=i, num_evals=100.0 * i, elapsed=1.0 * i,
+        device_s=device_s, host_s=host_s)
+
+
+def _run_segment(path, trace, *, iters, stop="niterations",
+                 request_id="req-1"):
+    led = CostLedger(path, run_id="det", trace=trace,
+                     request_id=request_id)
+    for i in iters:
+        led.on_iteration(_iter_ctx(i))
+    led.note_phase("checkpoint", 0.01)
+    led.note_phase("checkpoint", 0.02)
+    led.note_checkpoint(1024)
+    led.on_end({"stop_reason": stop, "elapsed_s": 9.0,
+                "num_evals": 100.0 * max(iters)})
+    return led
+
+
+def test_account_validates_and_buckets_latency(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    led = _run_segment(path, mint_run_trace("det"), iters=[1, 2, 3])
+    acct = led.account()
+    assert validate_account(acct) == []
+    assert acct["schema"] == LEDGER_SCHEMA
+    assert acct["deterministic"]["iterations"] == 3
+    assert acct["wall"]["device_s"] == pytest.approx(1.5)
+    assert acct["wall"]["phases"]["checkpoint"] == {
+        "count": 2, "seconds": pytest.approx(0.03)}
+    assert acct["wall"]["checkpoints"] == {"count": 1, "bytes": 1024}
+    counts = acct["wall"]["iteration_latency"]["counts"]
+    assert len(counts) == len(LATENCY_BUCKETS_S) + 1
+    assert sum(counts) == 3  # one sample per iteration
+    # 0.6s lands in the le=1.0 bucket
+    assert counts[LATENCY_BUCKETS_S.index(1.0)] == 3
+    assert validate_account({"schema": "nope"})  # malformed -> errors
+
+
+def test_bucket_latency_overflow_bucket():
+    counts = bucket_latency(120.0)
+    assert counts[-1] == 1 and sum(counts) == 1
+
+
+def test_fold_resumed_segments_matches_uninterrupted_twin(tmp_path):
+    trace = mint_trace("req-1", seed=7, niterations=4)
+    solo = str(tmp_path / "solo" / "ledger.jsonl")
+    _run_segment(solo, trace, iters=[1, 2, 3, 4])
+    resumed = str(tmp_path / "resumed" / "ledger.jsonl")
+    # killed after 2 iterations, then resumed: two segments, same file
+    _run_segment(resumed, trace, iters=[1, 2], stop="preempted")
+    _run_segment(resumed, trace, iters=[3, 4])
+    assert len(load_accounts(resumed)) == 2  # append, not truncate
+    assert fold_accounts(load_accounts(resumed)) == fold_accounts(
+        load_accounts(solo))
+    assert ledger_fingerprint(resumed) == ledger_fingerprint(solo)
+
+
+def test_fingerprint_ignores_wall_but_sees_content(tmp_path):
+    trace = mint_run_trace("det")
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _run_segment(a, trace, iters=[1, 2])
+    led = CostLedger(b, run_id="det", trace=trace, request_id="req-1")
+    for i in (1, 2):
+        led.on_iteration(_iter_ctx(i, device_s=9.0))  # wall-only change
+    led.on_end({"stop_reason": "niterations", "elapsed_s": 99.0,
+                "num_evals": 200.0})
+    assert ledger_fingerprint(a) == ledger_fingerprint(b)
+    c = str(tmp_path / "c.jsonl")
+    _run_segment(c, trace, iters=[1, 2, 3])  # content change
+    assert ledger_fingerprint(a) != ledger_fingerprint(c)
+
+
+def test_load_accounts_refuses_corruption(tmp_path):
+    p = tmp_path / "ledger.jsonl"
+    p.write_text('{"schema": "wrong"}\n')
+    with pytest.raises(ValueError):
+        load_accounts(str(p))
+    p.write_text("")
+    with pytest.raises(ValueError):
+        load_accounts(str(p))
+
+
+# ---------------------------------------------------------------------------
+# rollup
+# ---------------------------------------------------------------------------
+
+
+def _serve_root_fixture(tmp_path):
+    root = tmp_path / "root"
+    for rid, iters in (("req-a", [1, 2]), ("req-b", [1, 2, 3])):
+        d = root / "requests" / rid / rid
+        d.mkdir(parents=True)
+        _run_segment(
+            str(d / "ledger.jsonl"),
+            mint_trace(rid, seed=0, niterations=len(iters)),
+            iters=iters, request_id=rid)
+    return str(root)
+
+
+def test_rollup_builds_persists_and_loads(tmp_path):
+    root = _serve_root_fixture(tmp_path)
+    rollup = build_rollup(root)
+    assert rollup["errors"] == []
+    assert set(rollup["requests"]) == {"req-a", "req-b"}
+    a = rollup["requests"]["req-a"]
+    assert a["iterations"] == 2 and a["segments"] == 1
+    assert a["device_s"] == pytest.approx(1.0)
+    assert rollup["totals"]["device_s"] == pytest.approx(2.5)
+    assert rollup["totals"]["iterations"] == 5
+    assert sum(rollup["iteration_latency"]["counts"]) == 5
+    path = write_rollup(root)
+    assert path and os.path.exists(path)
+    loaded = load_rollup(root)
+    assert loaded is not None
+    assert loaded["requests"]["req-b"]["fingerprint"] == \
+        rollup["requests"]["req-b"]["fingerprint"]
+    assert load_rollup(str(tmp_path / "nowhere")) is None
+
+
+def test_rollup_reports_bad_files_instead_of_raising(tmp_path):
+    root = tmp_path / "root"
+    d = root / "requests" / "req-x" / "req-x"
+    d.mkdir(parents=True)
+    (d / "ledger.jsonl").write_text("not json\n")
+    rollup = build_rollup(str(root))
+    assert rollup["requests"] == {}
+    assert len(rollup["errors"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# unified timeline -> Chrome trace JSON (golden shape for Perfetto)
+# ---------------------------------------------------------------------------
+
+
+def _timeline_root(tmp_path):
+    root = _serve_root_fixture(tmp_path)
+    trace_a = mint_trace("req-a", seed=0, niterations=2)
+    for rid, trace in (("req-a", trace_a),):
+        stream = os.path.join(root, "requests", rid, rid,
+                              "telemetry.jsonl")
+        events = [
+            {"schema": SCHEMA_VERSION, "event": "run_start", "t": 10.0,
+             "trace": trace.child("search").to_dict(),
+             **_MINIMAL_FIELDS["run_start"]},
+            {"schema": SCHEMA_VERSION, "event": "iteration", "t": 11.0,
+             "trace": trace.child("search").to_dict(),
+             **_MINIMAL_FIELDS["iteration"]},
+            {"schema": SCHEMA_VERSION, "event": "pulse", "t": 11.5,
+             "trace": trace.child("search").to_dict(),
+             **_MINIMAL_FIELDS["pulse"]},
+            {"schema": SCHEMA_VERSION, "event": "run_end", "t": 12.0,
+             "trace": trace.child("search").to_dict(),
+             **_MINIMAL_FIELDS["run_end"]},
+        ]
+        with open(stream, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+    with open(os.path.join(root, "serve_telemetry.jsonl"), "w") as f:
+        for kind, t in (("accept", 9.0), ("start", 9.5), ("done", 13.0)):
+            f.write(json.dumps({
+                "schema": SCHEMA_VERSION, "event": "serve", "t": t,
+                "kind": kind, "request_id": "req-a",
+                "trace": trace_a.to_dict(), "detail": {}}) + "\n")
+    return root
+
+
+def test_timeline_is_valid_chrome_trace(tmp_path):
+    root = _timeline_root(tmp_path)
+    doc = build_timeline(root)
+    assert validate_chrome_trace(doc) == []
+    events = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    # Perfetto-required keys on every event
+    for e in events:
+        assert isinstance(e["ph"], str) and isinstance(e["name"], str)
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], (int, float))
+    by_name = {e["name"] for e in events}
+    assert {"process_name", "thread_name", "serve:accept",
+            "iteration 1", "device", "host",
+            "ledger segment 0"} <= by_name
+    # iteration slices are complete ("X") with microsecond dur
+    it = next(e for e in events if e["name"] == "iteration 1")
+    assert it["ph"] == "X" and it["dur"] == pytest.approx(0.6e6)
+    assert it["args"]["trace_id"] == mint_trace(
+        "req-a", seed=0, niterations=2).trace_id
+    # causal order: non-meta events sorted by ts
+    ts = [e["ts"] for e in events if e["ph"] != "M"]
+    assert ts == sorted(ts)
+
+
+def test_timeline_cli_writes_parseable_file(tmp_path, capsys):
+    from symbolicregression_jl_tpu.telemetry.report import main
+
+    root = _timeline_root(tmp_path)
+    out = str(tmp_path / "t.json")
+    assert main(["timeline", root, "--out", out]) == 0
+    with open(out) as f:
+        doc = json.load(f)
+    assert validate_chrome_trace(doc) == []
+    assert "trace events" in capsys.readouterr().out
+    # empty root -> error, not an empty-but-"valid" file
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert main(["timeline", empty, "--out",
+                 str(tmp_path / "e.json")]) == 1
+    assert main(["timeline"]) == 2  # usage
+
+
+def test_validate_chrome_trace_catches_malformed():
+    assert validate_chrome_trace([]) != []
+    bad = {"traceEvents": [
+        {"ph": "Z", "name": 3, "pid": "x"},
+        {"ph": "X", "name": "ok", "pid": 1, "tid": 0, "ts": 1.0},
+    ]}
+    errs = validate_chrome_trace(bad)
+    assert any("bad ph" in e for e in errs)
+    assert any("missing dur" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# tail rotation / truncation (telemetry/tail.py)
+# ---------------------------------------------------------------------------
+
+
+def _tail_event(run_id, i):
+    return json.dumps({
+        "schema": SCHEMA_VERSION, "event": "iteration", "t": float(i),
+        "run_id": run_id, **_MINIMAL_FIELDS["iteration"]}) + "\n"
+
+
+def test_tail_follower_reopens_on_rotation_and_truncation(tmp_path):
+    from symbolicregression_jl_tpu.telemetry.tail import TailFollower
+
+    path = str(tmp_path / "run.jsonl")
+    with open(path, "w") as f:
+        f.write(_tail_event("one", 1) + _tail_event("one", 2))
+    fol = TailFollower(path)
+    assert fol.poll() == 2 and fol.state.events == 2
+
+    # rotation: rename-and-recreate swaps the inode; the new file is
+    # LARGER than the old offset, so a size check alone would misread
+    # from a stale position mid-file
+    os.replace(path, path + ".1")
+    with open(path, "w") as f:
+        f.write(_tail_event("two", 1) * 3)
+    assert fol.poll() == 3
+    assert fol.state.events == 3  # restarted, not 5
+
+    # truncation in place (same inode, smaller size)
+    with open(path, "w") as f:
+        f.write(_tail_event("three", 1))
+    assert fol.poll() == 1
+    assert fol.state.events == 1
+
+    os.remove(path)
+    assert fol.poll() == 0  # gone = writer not up yet, no crash
+
+
+# ---------------------------------------------------------------------------
+# bit-neutrality pin: ledger on/off produces identical search results
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # two full searches; tools/ledger_smoke.py covers the
+# serve-path ledger end-to-end in CI on every push
+def test_ledger_on_off_hof_bit_identical(tmp_path):
+    from symbolicregression_jl_tpu import Options, equation_search
+    from symbolicregression_jl_tpu.api.search import RuntimeOptions
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (160, 2)).astype(np.float32)
+    y = (2.0 * X[:, 0] + X[:, 1] * X[:, 1]).astype(np.float32)
+
+    def run(sub, ledger):
+        state, _ = equation_search(
+            X, y,
+            options=Options(
+                binary_operators=["+", "*"], unary_operators=[],
+                maxsize=8, populations=2, population_size=8,
+                ncycles_per_iteration=2, tournament_selection_n=4,
+                save_to_file=True, output_directory=str(tmp_path / sub),
+                telemetry=True),
+            runtime_options=RuntimeOptions(
+                niterations=2, run_id="ab", seed=11, verbosity=0,
+                ledger=ledger),
+            return_state=True)
+        return state
+
+    s_on = run("on", True)
+    s_off = run("off", False)
+    on_path = tmp_path / "on" / "ab" / "ledger.jsonl"
+    assert on_path.exists()
+    accounts = load_accounts(str(on_path))
+    assert validate_account(accounts[-1]) == []
+    assert not (tmp_path / "off" / "ab" / "ledger.jsonl").exists()
+    a, b = s_on.device_states[0], s_off.device_states[0]
+    for f in ("arity", "op", "feat", "const", "length"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.hof.trees, f)),
+            np.asarray(getattr(b.hof.trees, f)))
+    np.testing.assert_array_equal(np.asarray(a.hof.cost),
+                                  np.asarray(b.hof.cost))
+    np.testing.assert_array_equal(np.asarray(a.pops.cost),
+                                  np.asarray(b.pops.cost))

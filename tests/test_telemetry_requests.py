@@ -116,3 +116,41 @@ def test_single_run_stream_has_no_requests_section():
         recompiles={"traces": 0, "backend_compiles": 0},
         transfer_guard_hits=0, outputs=[])]
     assert "requests" not in summarize(events)
+
+
+def test_mixed_v1_v2_directory_groups_by_trace_then_ids():
+    """A directory holding pre-graftledger (v1, no trace) runs next to
+    v2 runs still groups every event: v2 events join on trace_id even
+    when their human ids differ (serve stream request_id vs search
+    stream run_id), v1 events fall back to request_id/run_id, and the
+    group keys stay human-readable."""
+    trace = {"trace_id": "a" * 32, "span_id": "b" * 16,
+             "parent_id": None}
+    events = [
+        # v2 request: serve events carry request_id, the search stream
+        # a DIFFERENT run_id — only the shared trace joins them
+        _serve(1.0, "accept", "req-new"),
+        {**_ev("iteration", 2.0, run_id="run-of-req-new", iteration=1,
+               num_evals=10.0, evals_per_sec=1.0, elapsed_s=1.0,
+               device_s=0.5, host_s=0.1, host_fraction=0.1,
+               recompiles={"traces": 0, "backend_compiles": 0},
+               transfer_guard_hits=0, outputs=[]),
+         "trace": trace},
+        _serve(3.0, "done", "req-new"),
+        # v1 request: no trace field at all, old schema string
+        {"schema": "graftscope.v1", "t": 4.0, "event": "serve",
+         "kind": "accept", "request_id": "req-old", "detail": {}},
+        {"schema": "graftscope.v1", "t": 5.0, "event": "serve",
+         "kind": "done", "request_id": "req-old", "detail": {}},
+    ]
+    events[0]["trace"] = trace
+    events[2]["trace"] = trace
+    assert validate_lines([json.dumps(e) for e in events]) == []
+    groups = summarize_requests(events)
+    assert set(groups) == {"req-new", "req-old"}
+    new = groups["req-new"]
+    # the search stream's iteration folded into the serve group
+    assert new["iterations"] == 1
+    assert new["serve"] == {"accept": 1, "done": 1}
+    assert new["trace_id"] == "a" * 32
+    assert groups["req-old"]["trace_id"] is None

@@ -32,6 +32,9 @@ JAX_PLATFORMS=cpu python tools/pulse_smoke.py
 echo "== graftserve: kill-restart-replay + overload smoke (docs/SERVING.md) =="
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
+echo "== graftledger: cost attribution + trace + timeline smoke (docs/OBSERVABILITY.md) =="
+JAX_PLATFORMS=cpu python tools/ledger_smoke.py
+
 echo "== graftmesh: mesh dryrun fast tier (docs/SCALING.md) =="
 JAX_PLATFORMS=cpu python -m symbolicregression_jl_tpu.mesh.dryrun \
     --devices 8 --fast --out "${TMPDIR:-/tmp}/graftmesh/dryrun.json"

@@ -1,0 +1,321 @@
+"""CI ledger smoke: graftledger's attribution + tracing contracts, end
+to end on CPU over a real 2-request serve root (docs/OBSERVABILITY.md
+"Cost attribution & tracing"; tools/check.sh and the CI ``ledger-smoke``
+job)::
+
+    python tools/ledger_smoke.py [out_base]
+
+Checks, against an uninterrupted reference root AND a killed-and-
+resumed root (SIGTERM mid-request via the serve fault harness):
+
+1. every per-request ``ledger.jsonl`` validates against graftledger.v1
+   and its attributed device+host seconds land within 20% of the
+   request's measured wall time (attribution that doesn't add up is
+   worse than none);
+2. every event in every stream — serve lifecycle and per-request
+   graftscope — carries the graftledger trace context, and the ids are
+   exactly the deterministic mint for that request;
+3. ``telemetry timeline`` exports the root as Chrome trace-event JSON
+   that parses and passes the Perfetto shape check;
+4. kill-restart-replay reproduces IDENTICAL deterministic ledger views:
+   per-request fold fingerprints equal across the killed root and the
+   reference root (and the server's rollup agrees), alongside the
+   bit-identical hall-of-fame fingerprints serve_smoke already pins.
+
+The subprocess phase reuses this file: ``--phase run`` creates (or
+recovers) a server over ``--root``, submits the standard 2-request set
+when the journal is empty, drains, and prints a JSON result map.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SYMBOLIC_REGRESSION_IS_TESTING", "true")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+SEEDS = (5, 9)
+NITER = 4
+
+
+def _problem():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2.0, 2.0, (128, 2)).astype(np.float32)
+    y = (X[:, 0] * 2.0 + X[:, 1] * X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options():
+    return dict(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=2,
+        tournament_selection_n=4,
+        optimizer_probability=0.0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# subprocess phase
+# ---------------------------------------------------------------------------
+
+
+def phase_run(root: str, kill_at: int) -> int:
+    """Create/recover a server over ``root``, drain it, print results."""
+    from symbolicregression_jl_tpu.serve import SearchServer
+    from symbolicregression_jl_tpu.shield import faults
+
+    if kill_at:
+        faults.install_serve(faults.ServeFaultInjector(
+            faults.ServeFaultPlan(kill_server_at_request=kill_at)))
+    X, y = _problem()
+    srv = SearchServer(root, capacity=8, workers=1)
+    if not srv.requests():  # fresh root: submit the standard set
+        for seed in SEEDS:
+            srv.submit(X, y, options=_options(), niterations=NITER,
+                       seed=seed, request_id=f"req-seed{seed}")
+    srv.start()
+    deadline = time.monotonic() + 600
+    while time.monotonic() < deadline:
+        if srv._preempt_requested():
+            srv.stop(drain=False)
+            break
+        if srv.wait_idle(timeout=0.5):
+            srv.stop(drain=True)
+            break
+    out = {
+        s["request_id"]: {
+            "state": s["state"],
+            "fingerprint": (s["result"] or {}).get("fingerprint"),
+            "resumed": s["resumed"],
+        }
+        for s in srv.requests()
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def _run_subprocess(root: str, kill_at: int = 0) -> dict:
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--phase", "run", "--root", root]
+    if kill_at:
+        cmd += ["--kill-at", str(kill_at)]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=900,
+        env=dict(os.environ))
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"phase run failed rc={proc.returncode}:\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+# ---------------------------------------------------------------------------
+# per-root checks
+# ---------------------------------------------------------------------------
+
+
+def _ledger_paths(root: str) -> dict:
+    from symbolicregression_jl_tpu.ledger import request_ledger_paths
+
+    paths = {}
+    for p in request_ledger_paths(root):
+        rid = os.path.basename(os.path.dirname(p))
+        paths[rid] = p
+    expected = {f"req-seed{s}" for s in SEEDS}
+    assert set(paths) == expected, (
+        f"ledger files {sorted(paths)} != requests {sorted(expected)}")
+    return paths
+
+
+def check_accounts_and_attribution(root: str) -> dict:
+    """Check 1: accounts validate; device+host within 20% of wall.
+    Returns {request_id: fold fingerprint}."""
+    from symbolicregression_jl_tpu.ledger import (
+        ledger_fingerprint,
+        load_accounts,
+        validate_account,
+    )
+
+    fingerprints = {}
+    for rid, path in _ledger_paths(root).items():
+        accounts = load_accounts(path)  # raises on any invalid segment
+        for a in accounts:
+            assert validate_account(a) == [], (rid, a)
+        attributed = sum(
+            a["wall"]["device_s"] + a["wall"]["host_s"] for a in accounts)
+        wall = sum(a["wall"]["elapsed_s"] for a in accounts)
+        assert wall > 0, f"{rid}: zero wall time in ledger"
+        # 20% relative envelope, with a 100ms absolute floor: a request
+        # whose executables were all cache hits finishes in tens of
+        # milliseconds, where scheduler jitter swamps any ratio
+        gap = abs(attributed - wall)
+        assert gap <= max(0.2 * wall, 0.1), (
+            f"{rid}: attributed {attributed:.2f}s vs wall {wall:.2f}s "
+            f"(gap {gap:.3f}s) — attribution out of the 20% envelope")
+        fingerprints[rid] = ledger_fingerprint(path)
+    return fingerprints
+
+
+def check_trace_propagation(root: str) -> None:
+    """Check 2: every emitted event carries the deterministic trace."""
+    from symbolicregression_jl_tpu.ledger import mint_trace
+    from symbolicregression_jl_tpu.telemetry.schema import (
+        load_events_tolerant,
+    )
+
+    expected = {
+        f"req-seed{s}": mint_trace(
+            f"req-seed{s}", seed=s, niterations=NITER).trace_id
+        for s in SEEDS
+    }
+    serve_stream = os.path.join(root, "serve_telemetry.jsonl")
+    events, _ = load_events_tolerant(serve_stream)
+    assert events, f"empty serve stream {serve_stream}"
+    for e in events:
+        trace = e.get("trace")
+        assert isinstance(trace, dict) and trace.get("trace_id"), (
+            f"serve event without trace context: {e}")
+        rid = e.get("request_id") or e.get("detail", {}).get("request_id")
+        if rid in expected:
+            assert trace["trace_id"] == expected[rid], (
+                f"{rid}: serve event trace_id {trace['trace_id']} is not "
+                f"the deterministic mint {expected[rid]}")
+    for rid, tid in expected.items():
+        stream = os.path.join(root, "requests", rid, rid,
+                              "telemetry.jsonl")
+        events, _ = load_events_tolerant(stream)
+        assert events, f"empty request stream {stream}"
+        for e in events:
+            trace = e.get("trace")
+            assert isinstance(trace, dict), (
+                f"{rid}: event without trace: {e.get('event')}")
+            assert trace.get("trace_id") == tid, (
+                f"{rid}: {e.get('event')} trace_id {trace.get('trace_id')}"
+                f" != minted {tid}")
+
+
+def check_timeline_export(root: str, out_path: str) -> None:
+    """Check 3: the timeline CLI emits parseable, valid Chrome trace."""
+    from symbolicregression_jl_tpu.ledger import validate_chrome_trace
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "symbolicregression_jl_tpu.telemetry",
+         "timeline", root, "--out", out_path],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ))
+    assert proc.returncode == 0, (
+        f"timeline CLI rc={proc.returncode}: {proc.stderr[-1000:]}")
+    with open(out_path) as f:
+        doc = json.load(f)  # must parse as plain JSON
+    errors = validate_chrome_trace(doc)
+    assert errors == [], f"invalid Chrome trace: {errors[:5]}"
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert any(n.startswith("iteration ") for n in names), names
+    assert any(n.startswith("ledger segment") for n in names), names
+    assert any(n.startswith("serve:") for n in names), names
+
+
+def check_rollup(root: str, fingerprints: dict) -> None:
+    """The server-written rollup agrees with the per-request files."""
+    from symbolicregression_jl_tpu.ledger import load_rollup
+
+    rollup = load_rollup(root)
+    assert rollup is not None, f"no ledger rollup under {root}"
+    assert rollup["errors"] == [], rollup["errors"]
+    assert set(rollup["requests"]) == set(fingerprints)
+    for rid, fp in fingerprints.items():
+        assert rollup["requests"][rid]["fingerprint"] == fp, rid
+        assert rollup["requests"][rid]["iterations"] == NITER, rid
+    assert rollup["totals"]["device_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+
+def scenario_reference_root(out_base: str) -> dict:
+    root = os.path.join(out_base, "ref")
+    ref = _run_subprocess(root)
+    assert all(v["state"] == "done" for v in ref.values()), ref
+    fingerprints = check_accounts_and_attribution(root)
+    check_trace_propagation(root)
+    check_rollup(root, fingerprints)
+    check_timeline_export(root, os.path.join(out_base, "ref_timeline.json"))
+    return {"hof": {r: v["fingerprint"] for r, v in ref.items()},
+            "ledger": fingerprints}
+
+
+def scenario_kill_restart_replay(out_base: str, ref: dict) -> None:
+    root = os.path.join(out_base, "kill")
+    partial = _run_subprocess(root, kill_at=2)
+    unfinished = [r for r, v in partial.items() if v["state"] != "done"]
+    assert unfinished, f"kill fired too late — nothing in flight: {partial}"
+
+    resumed = _run_subprocess(root)
+    assert all(v["state"] == "done" for v in resumed.values()), resumed
+    for rid, fp in ref["hof"].items():
+        assert resumed[rid]["fingerprint"] == fp, (
+            f"{rid}: killed-and-restarted HoF differs from reference")
+
+    fingerprints = check_accounts_and_attribution(root)
+    check_trace_propagation(root)
+    check_rollup(root, fingerprints)
+    check_timeline_export(root, os.path.join(out_base,
+                                             "kill_timeline.json"))
+    # the headline: deterministic ledger views are root-independent AND
+    # kill-independent — the resumed request's folded account equals the
+    # uninterrupted reference's, fingerprint for fingerprint
+    assert fingerprints == ref["ledger"], (
+        f"ledger fingerprints diverged across kill-restart-replay:\n"
+        f"  ref:  {ref['ledger']}\n  kill: {fingerprints}")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("out_base", nargs="?",
+                        default="/tmp/sr_ledger_smoke")
+    parser.add_argument("--phase", choices=["run"], default=None)
+    parser.add_argument("--root", default=None)
+    parser.add_argument("--kill-at", type=int, default=0)
+    args = parser.parse_args()
+
+    if args.phase == "run":
+        return phase_run(args.root, args.kill_at)
+
+    # idempotent re-runs: stale journals would replay into this run
+    import shutil
+
+    for sub in ("ref", "kill"):
+        shutil.rmtree(os.path.join(args.out_base, sub), ignore_errors=True)
+
+    try:
+        ref = scenario_reference_root(args.out_base)
+    except Exception as e:  # noqa: BLE001 - report and fail the job
+        print(f"FAIL [ledger-reference-root]: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    print("OK   [ledger-reference-root]")
+    try:
+        scenario_kill_restart_replay(args.out_base, ref)
+    except Exception as e:  # noqa: BLE001
+        print(f"FAIL [ledger-kill-restart-replay]: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    print("OK   [ledger-kill-restart-replay]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
