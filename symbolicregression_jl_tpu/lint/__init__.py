@@ -2,7 +2,11 @@
 
 Static side (pure-Python AST, no JAX import needed):
 
-- :data:`~.rules.RULES` — table-driven rule registry (GL001-GL006)
+- :data:`~.rules.RULES` — table-driven rule registry: GL001-GL008
+  (single-module JAX hazards) plus the graftwarden concurrency rules
+  GL009-GL014 (:mod:`.concurrency` — interprocedural lock-context
+  dataflow over the serve/shield thread fabric, checked against the
+  blessed lock-order manifest in :mod:`.lock_order`)
 - :func:`~.cli.lint_source` / :func:`~.cli.lint_paths` — programmatic API
 - ``python -m symbolicregression_jl_tpu.lint <paths>`` — CLI, exits
   nonzero on findings
@@ -12,6 +16,9 @@ Runtime side (imports JAX lazily via :mod:`.runtime`):
 - :func:`~.runtime.validate_programs` — postfix program-table invariants
 - :func:`~.runtime.compile_count_guard` — "no recompiles in this region"
 - :func:`~.runtime.no_transfer` — "no implicit host↔device transfers"
+- :mod:`.racecheck` — instrumented lock wrappers that assert the
+  lock-order manifest at runtime and replay races deterministically
+  via ``SR_RACE_PLAN`` context-switch windows
 
 The static analyzer intentionally avoids importing :mod:`jax` so the CLI
 stays usable (and fast) in environments without an accelerator stack.
@@ -19,14 +26,17 @@ stays usable (and fast) in environments without an accelerator stack.
 
 from .analyzer import Finding, ModuleAnalysis
 from .cli import lint_paths, lint_source, main
+from .lock_order import BLESSED_EDGES, check_manifest_acyclic
 from .rules import RULES, Rule, rule
 
 __all__ = [
+    "BLESSED_EDGES",
     "Finding",
     "ModuleAnalysis",
     "RULES",
     "Rule",
     "rule",
+    "check_manifest_acyclic",
     "lint_paths",
     "lint_source",
     "main",

@@ -16,6 +16,9 @@ from typing import Iterable, List, Optional, Sequence, Set
 from .analyzer import Finding, ModuleAnalysis
 from .rules import RULES, run_rules
 
+# registers GL009-GL014 (graftwarden concurrency rules) in RULES
+from . import concurrency  # noqa: E402,F401  isort:skip
+
 __all__ = ["lint_source", "lint_paths", "iter_py_files", "main"]
 
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build"}

@@ -77,17 +77,30 @@ class MetricsServer:
         self.render = render
         self._requested_port = int(port)
         self.host = host
+        # guards the _httpd/_thread lifecycle handoff only — never held
+        # across bind/shutdown/join (those block on the network stack)
+        self._state_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     @property
     def port(self) -> Optional[int]:
         """The bound port (after ``start()``; resolves port=0)."""
-        return self._httpd.server_address[1] if self._httpd else None
+        httpd = self._httpd
+        return httpd.server_address[1] if httpd else None
+
+    @property
+    def running(self) -> bool:
+        return self._httpd is not None
 
     def start(self) -> "MetricsServer":
-        if self._httpd is not None:
-            return self
+        with self._state_lock:
+            if self._httpd is not None:
+                # a second bind would leak a ThreadingHTTPServer on a
+                # second port behind the caller's back — refuse loudly;
+                # callers that may race a live endpoint check .running
+                raise RuntimeError(
+                    f"MetricsServer already serving on port {self.port}")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -116,22 +129,35 @@ class MetricsServer:
             def log_message(self, *args) -> None:
                 pass  # scrapes every few seconds; stderr stays quiet
 
-        self._httpd = ThreadingHTTPServer(
+        # bind OUTSIDE the state lock (it can block in the network
+        # stack); publish under it, losing a concurrent start() cleanly
+        httpd = ThreadingHTTPServer(
             (self.host, self._requested_port), Handler)
-        self._httpd.daemon_threads = True
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name="graftserve-metrics", daemon=True,
-        )
-        self._thread.start()
+        httpd.daemon_threads = True
+        with self._state_lock:
+            if self._httpd is not None:
+                httpd.server_close()
+                raise RuntimeError(
+                    f"MetricsServer already serving on port {self.port}")
+            self._httpd = httpd
+            self._thread = threading.Thread(
+                target=httpd.serve_forever,
+                name="graftserve-metrics", daemon=True,
+            )
+            self._thread.start()
         return self
 
     def stop(self) -> None:
-        if self._httpd is None:
+        """Shut the endpoint down and join the serving thread (bounded).
+        Idempotent — concurrent/repeat stops take the refs under the
+        state lock, so exactly one caller does the shutdown."""
+        with self._state_lock:
+            httpd, thread = self._httpd, self._thread
+            self._httpd = None
+            self._thread = None
+        if httpd is None:
             return
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-        self._httpd = None
-        self._thread = None
+        httpd.shutdown()
+        httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)

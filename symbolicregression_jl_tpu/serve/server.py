@@ -282,6 +282,7 @@ class SearchServer:
         hang_grace_s: float = 60.0,
         telemetry: bool = True,
         metrics_port: Optional[int] = None,
+        debug_checks: bool = False,
     ) -> None:
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
@@ -327,6 +328,17 @@ class SearchServer:
 
             self.metrics = MetricsServer(self.metrics_text,
                                          port=metrics_port)
+        # graftwarden runtime auditor (lint/racecheck.py): wraps every
+        # serve/shield lock, asserts actual acquisition order against
+        # the blessed lint/lock_order.py manifest, and honors the
+        # SR_RACE_PLAN deterministic context-switch windows. Opt-in —
+        # ctor flag or SR_RACECHECK=1 — so production pays nothing.
+        self.debug_checks = bool(debug_checks) or bool(
+            os.environ.get("SR_RACECHECK"))
+        if self.debug_checks:
+            from ..lint.racecheck import instrument_server
+
+            self._race_recorder = instrument_server(self)
         self._recover()
 
     # ------------------------------------------------------------------
@@ -393,7 +405,9 @@ class SearchServer:
                 continue
             r.resumed = started.get(rid, False)
             self.admission.readmit(r.request.bucket)
-            self._qseq += 1
+            # construction-time: _recover runs from __init__ before any
+            # worker thread exists, so the queue counter is unshared
+            self._qseq += 1  # graftlint: disable=GL011
             heapq.heappush(self._queue, (priority, self._qseq, rid))
             self.log.serve(
                 "replay", rid, trace=r.request.trace, resumed=r.resumed,
@@ -695,7 +709,9 @@ class SearchServer:
                 )
                 t.start()
                 self._threads.append(t)
-        if self.metrics is not None:
+        if self.metrics is not None and not self.metrics.running:
+            # .running guard: a stop() that timed out keeps the endpoint
+            # up, and MetricsServer.start() now refuses a double bind
             self.metrics.start()
         return self
 

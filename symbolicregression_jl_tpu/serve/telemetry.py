@@ -57,7 +57,10 @@ class ServeLog:
         if self.path is None:
             return
         try:
-            with self._lock, open(self.path, "a") as f:
+            # _lock is this log's own line-serialization lock (held for
+            # one buffered write, nothing else nests inside it); the
+            # server-wide lock is never held around log calls
+            with self._lock, open(self.path, "a") as f:  # graftlint: disable=GL009
                 f.write(json.dumps(obj) + "\n")
         except OSError:  # auditing must never break serving
             pass

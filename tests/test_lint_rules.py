@@ -735,3 +735,419 @@ def test_gl008_ignores_modules_without_shard_map():
         path="pkg/mesh/no_smap.py",
     )
     assert "GL008" not in _ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# graftwarden concurrency rules (GL009-GL014) — fixture paths use a
+# serve/ component so the scope matches; the roots don't exist on disk,
+# so each fixture is analyzed in single-module mode
+# ---------------------------------------------------------------------------
+
+
+def _lint_serve(src: str):
+    return _lint(src, path="pkg/serve/mod.py")
+
+
+def test_warden_registry_has_concurrency_rules():
+    for rid in ("GL009", "GL010", "GL011", "GL012", "GL013", "GL014"):
+        assert rid in RULES, f"{rid} not registered"
+
+
+def test_gl009_flags_direct_and_transitive_blocking_io_under_lock():
+    findings = _lint_serve(
+        """
+        import os
+        import threading
+
+        class Journal:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.path = "j.jsonl"
+
+            def _append(self, line):
+                with open(self.path, "a") as f:
+                    f.write(line)
+                    os.fsync(f.fileno())
+
+            def direct(self, line):
+                with self._lock:
+                    with open(self.path, "a") as f:
+                        f.write(line)
+
+            def transitive(self, line):
+                with self._lock:
+                    self._append(line)
+        """
+    )
+    gl009 = [f for f in findings if f.rule_id == "GL009"]
+    assert len(gl009) >= 2  # the direct open AND the call into _append
+
+
+def test_gl009_clean_io_outside_lock():
+    findings = _lint_serve(
+        """
+        import os
+        import threading
+
+        class Journal:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.path = "j.jsonl"
+
+            def append(self, line):
+                with self._lock:
+                    self._seq = getattr(self, "_seq", 0) + 1
+                with open(self.path, "a") as f:
+                    f.write(line)
+                    os.fsync(f.fileno())
+        """
+    )
+    assert "GL009" not in _ids(findings)
+
+
+def test_gl010_flags_opposite_order_cycle():
+    findings = _lint_serve(
+        """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """
+    )
+    assert "GL010" in _ids(findings)
+
+
+def test_gl010_flags_blessed_manifest_inversion_through_call():
+    # AdmissionController holding its own lock calls into a method that
+    # takes the server lock: the manifest sanctions SearchServer._lock
+    # BEFORE AdmissionController._lock, so this derived edge inverts it
+    findings = _lint_serve(
+        """
+        import threading
+
+        class SearchServer:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def poke(self):
+                with self._lock:
+                    return 1
+
+        class AdmissionController:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.server = SearchServer()
+
+            def admit(self):
+                with self._lock:
+                    return self.server.poke()
+        """
+    )
+    assert "GL010" in _ids(findings)
+
+
+def test_gl010_clean_consistent_order():
+    findings = _lint_serve(
+        """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """
+    )
+    assert "GL010" not in _ids(findings)
+
+
+def test_gl011_flags_unguarded_write_across_thread_boundary():
+    findings = _lint_serve(
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                self.count += 1
+
+            def bump(self):
+                self.count += 1
+        """
+    )
+    assert "GL011" in _ids(findings)
+
+
+def test_gl011_clean_when_every_write_holds_the_lock():
+    findings = _lint_serve(
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                with self._lock:
+                    self.count += 1
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+        """
+    )
+    assert "GL011" not in _ids(findings)
+
+
+def test_gl011_thread_confined_attr_is_clean():
+    findings = _lint_serve(
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                self.progress = 1  # only the worker writes it
+        """
+    )
+    assert "GL011" not in _ids(findings)
+
+
+def test_gl012_flags_wait_outside_while():
+    findings = _lint_serve(
+        """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self.ready = False
+
+            def get(self):
+                with self._cond:
+                    if not self.ready:
+                        self._cond.wait()
+                    return 1
+        """
+    )
+    assert "GL012" in _ids(findings)
+
+
+def test_gl012_clean_wait_in_while_and_event_wait():
+    findings = _lint_serve(
+        """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._stop = threading.Event()
+                self.ready = False
+
+            def get(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait(timeout=0.5)
+                    return 1
+
+            def pause(self):
+                self._stop.wait(1.0)  # Event.wait is level-triggered
+        """
+    )
+    assert "GL012" not in _ids(findings)
+
+
+def test_gl013_flags_jax_dispatch_under_lock():
+    findings = _lint_serve(
+        """
+        import threading
+        import jax
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def publish(self, x):
+                with self._lock:
+                    self.result = jax.block_until_ready(x)
+        """
+    )
+    assert "GL013" in _ids(findings)
+
+
+def test_gl013_clean_dispatch_outside_lock():
+    findings = _lint_serve(
+        """
+        import threading
+        import jax
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def publish(self, x):
+                r = jax.block_until_ready(x)
+                with self._lock:
+                    self.result = r
+        """
+    )
+    assert "GL013" not in _ids(findings)
+
+
+def test_gl014_flags_hazard_reachable_from_handler():
+    # the handler body itself is flag-only (GL007 stays quiet); the
+    # hazard is two calls deep — only the interprocedural closure sees it
+    findings = _lint(
+        """
+        import json
+        import signal
+
+        def _save(state):
+            with open("ckpt.json", "w") as f:
+                json.dump(state, f)
+
+        def _flag(state):
+            _save(state)
+
+        def _handler(signum, frame):
+            _flag({"signum": signum})
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+        """,
+        path="pkg/shield/mod.py",
+    )
+    ids = _ids(findings)
+    assert "GL014" in ids
+    assert "GL007" not in ids
+
+
+def test_gl014_clean_flag_only_closure():
+    findings = _lint(
+        """
+        import signal
+        import threading
+
+        _EVENT = threading.Event()
+
+        def _note():
+            _EVENT.set()
+
+        def _handler(signum, frame):
+            _note()
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+        """,
+        path="pkg/shield/mod.py",
+    )
+    assert "GL014" not in _ids(findings)
+
+
+def test_warden_rules_respect_suppression():
+    findings = _lint_serve(
+        """
+        import os
+        import threading
+
+        class Journal:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def append(self, line):
+                with self._lock:
+                    with open("j", "a") as f:  # graftlint: disable=GL009
+                        f.write(line)
+        """
+    )
+    assert "GL009" not in _ids(findings)
+
+
+def test_warden_rules_out_of_scope_path_is_clean():
+    findings = _lint(
+        """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """,
+        path="pkg/evolve/mod.py",
+    )
+    assert "GL010" not in _ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# lock-order manifest
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_manifest_is_acyclic():
+    from symbolicregression_jl_tpu.lint.lock_order import (
+        BLESSED_EDGES, check_manifest_acyclic)
+
+    check_manifest_acyclic(BLESSED_EDGES)  # must not raise
+
+
+def test_lock_order_manifest_drift_cycle_fails():
+    from symbolicregression_jl_tpu.lint.lock_order import (
+        BLESSED_EDGES, check_manifest_acyclic)
+
+    bad = BLESSED_EDGES + (
+        ("AdmissionController._lock", "SearchServer._lock"),)
+    with pytest.raises(ValueError, match="cycle"):
+        check_manifest_acyclic(bad)
+
+
+def test_lock_order_violates_is_a_partial_order():
+    from symbolicregression_jl_tpu.lint.lock_order import violates
+
+    # the sanctioned direction and unrelated pairs are fine
+    assert not violates("SearchServer._lock", "AdmissionController._lock")
+    assert not violates("ExecutableCache._lock", "MetricsServer._state_lock")
+    assert not violates("SearchServer._lock", "SearchServer._lock")
+    # the reverse of a blessed edge (direct or transitive) violates
+    assert violates("AdmissionController._lock", "SearchServer._lock")
+    assert violates("ServeLog._lock", "SearchServer._lock")  # transitive

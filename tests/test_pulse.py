@@ -626,6 +626,31 @@ def test_server_metrics_text_and_http(tmp_path):
         ms.stop()
 
 
+def test_metrics_server_lifecycle(tmp_path):
+    from symbolicregression_jl_tpu.serve.metrics import MetricsServer
+    from symbolicregression_jl_tpu.serve.server import SearchServer
+
+    server = SearchServer(str(tmp_path / "root"), capacity=3,
+                          telemetry=False)
+    ms = MetricsServer(server.metrics_text, port=0).start()
+    assert ms.running
+    first_port = ms.port
+    # a second start() must refuse instead of leaking a second
+    # ThreadingHTTPServer on another port behind the caller's back
+    with pytest.raises(RuntimeError, match="already serving"):
+        ms.start()
+    assert ms.port == first_port
+    # stop() joins the serving thread and is idempotent
+    ms.stop()
+    assert not ms.running and ms.port is None
+    ms.stop()  # second stop: no-op, no raise
+    # a full stop->start cycle rebinds cleanly
+    ms.start()
+    assert ms.running
+    ms.stop()
+    assert not ms.running
+
+
 # ---------------------------------------------------------------------------
 # bench trend: anomalies in a green run make the row red
 # ---------------------------------------------------------------------------

@@ -1,0 +1,220 @@
+"""graftwarden runtime auditor: instrumented locks must be transparent,
+actual lock-order inversions must raise against the blessed manifest,
+and the three PR-6 races must replay deterministically under
+SR_RACE_PLAN — passing on current code, failing on a reverted shim
+(the shim legs prove each replay actually lands on the fixed line).
+
+The cancel-vs-submit replay needs no search (workers=0) and runs in the
+fast tier; the two search-driven replays are `slow` (tools/race_smoke.py
+runs all three in CI's warden-smoke job).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.lint.racecheck import (
+    InstrumentedLock,
+    LockOrderViolation,
+    LockRecorder,
+    RacePlan,
+    clear_race_plan,
+    global_recorder,
+    install_race_plan,
+    instrument_server,
+    replay_scenario,
+)
+from symbolicregression_jl_tpu.lint.lock_order import (
+    BLESSED_EDGES,
+    blessed_closure,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    clear_race_plan()
+    yield
+    clear_race_plan()
+
+
+# ---------------------------------------------------------------------------
+# instrumented lock semantics
+# ---------------------------------------------------------------------------
+
+
+def test_instrumented_lock_is_a_context_manager_and_reentrant():
+    lk = InstrumentedLock("SearchServer._lock")
+    with lk:
+        with lk:  # RLock reentrancy passes through
+            pass
+    assert global_recorder().held() == []
+
+
+def test_blessed_nesting_passes_and_inversion_raises():
+    # a dedicated recorder: the deliberate inversion below must not
+    # pollute the process-global edge/violation log other tests check
+    rec = LockRecorder()
+    srv = InstrumentedLock("SearchServer._lock", recorder=rec)
+    adm = InstrumentedLock("AdmissionController._lock", recorder=rec)
+    with srv:
+        with adm:  # the sanctioned direction
+            pass
+    with pytest.raises(LockOrderViolation):
+        with adm:
+            with srv:  # inverts the manifest
+                pass
+    # the raise happened BEFORE the inner acquire: nothing stays held
+    assert rec.held() == []
+
+
+def test_transitive_inversion_raises():
+    rec = LockRecorder()
+    log = InstrumentedLock("ServeLog._lock", recorder=rec)
+    srv = InstrumentedLock("SearchServer._lock", recorder=rec)
+    # ServeLog is reachable from SearchServer through the manifest, so
+    # holding it while taking the server lock is an inversion too
+    with pytest.raises(LockOrderViolation):
+        with log:
+            with srv:
+                pass
+
+
+def test_unordered_locks_do_not_raise():
+    cache = InstrumentedLock("ExecutableCache._lock")
+    metrics = InstrumentedLock("MetricsServer._state_lock")
+    with cache:
+        with metrics:
+            pass
+    with metrics:
+        with cache:
+            pass  # partial order: unrelated pairs are unordered
+
+
+def test_condition_over_instrumented_lock():
+    lk = InstrumentedLock("SearchServer._lock")
+    cond = threading.Condition(lk)
+    state = {"go": False}
+
+    def _setter():
+        with cond:
+            state["go"] = True
+            cond.notify_all()
+
+    t = threading.Timer(0.05, _setter)
+    t.start()
+    with cond:
+        with lk:  # reentrant hold across the wait
+            while not state["go"]:
+                cond.wait(timeout=1.0)
+    t.join()
+    assert global_recorder().held() == []
+
+
+def test_race_plan_window_pauses_nth_matching_acquire():
+    lk = InstrumentedLock("RequestJournal._lock")
+    plan = install_race_plan(RacePlan.from_dict({"windows": [{
+        "lock": "RequestJournal._lock", "op": "acquire",
+        "caller": "target_fn", "nth": 2, "pause_s": 0.05}]}))
+    window = plan.windows[0]
+
+    def target_fn():
+        with lk:
+            pass
+
+    def other_fn():
+        with lk:
+            pass
+
+    other_fn()  # wrong caller: not counted
+    target_fn()  # nth=1
+    assert not window.entered.is_set()
+    target_fn()  # nth=2: fires
+    assert window.entered.is_set()
+    target_fn()  # one-shot: no re-fire, no hang
+
+
+# ---------------------------------------------------------------------------
+# server instrumentation transparency
+# ---------------------------------------------------------------------------
+
+
+def test_instrumented_server_serves_normally(tmp_path):
+    from symbolicregression_jl_tpu.serve.server import SearchServer
+
+    srv = SearchServer(str(tmp_path / "root"), capacity=4, workers=0,
+                       debug_checks=True)
+    assert isinstance(srv._lock, InstrumentedLock)
+    assert isinstance(srv.journal._lock, InstrumentedLock)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 2)).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    rid = srv.submit(X, y, options=dict(
+        binary_operators=["+", "*"], unary_operators=[], maxsize=8,
+        populations=2, population_size=8, ncycles_per_iteration=2,
+        tournament_selection_n=4, optimizer_probability=0.0,
+    ), niterations=1)
+    assert srv.poll(rid)["state"] == "queued"
+    assert srv.cancel(rid) is True
+    assert srv.poll(rid)["state"] == "cancelled"
+    # every edge the instrumented run observed is blessed (directly or
+    # by being unordered) — no inversions were recorded
+    assert global_recorder().violations == []
+    closure = blessed_closure(BLESSED_EDGES)
+    for (a, b) in global_recorder().edges:
+        assert a not in closure.get(b, ()), f"inverted edge {a} -> {b}"
+
+
+def test_instrument_server_is_idempotent(tmp_path):
+    from symbolicregression_jl_tpu.serve.server import SearchServer
+
+    srv = SearchServer(str(tmp_path / "root"), capacity=4, workers=0,
+                       debug_checks=True)
+    inner = srv._lock.inner
+    instrument_server(srv)  # second call must not double-wrap
+    assert srv._lock.inner is inner
+
+
+# ---------------------------------------------------------------------------
+# the three PR-6 races, replayed
+# ---------------------------------------------------------------------------
+
+
+def test_replay_cancel_vs_submit_passes_on_current_code(tmp_path):
+    r = replay_scenario("cancel_vs_submit", str(tmp_path / "cur"))
+    assert r["ok"], r
+
+
+def test_replay_cancel_vs_submit_detects_reverted_fix(tmp_path):
+    r = replay_scenario("cancel_vs_submit", str(tmp_path / "shim"),
+                        shim=True)
+    assert not r["ok"], r
+    # the shim's journal holds the cancel BEFORE its submit — the exact
+    # resurrection signature the fix closed
+    assert r["detail"]["replayed_state"] == "queued"
+
+
+@pytest.mark.slow
+def test_replay_cancel_overlapping_preemption(tmp_path):
+    r = replay_scenario("cancel_overlapping_preemption",
+                        str(tmp_path / "cur"))
+    assert r["ok"], r
+    r2 = replay_scenario("cancel_overlapping_preemption",
+                         str(tmp_path / "shim"), shim=True)
+    assert not r2["ok"], r2
+    assert r2["detail"]["state"] == "queued"  # resurrection signature
+
+
+@pytest.mark.slow
+def test_replay_stale_guard_restart(tmp_path):
+    r = replay_scenario("stale_guard_restart", str(tmp_path / "cur"))
+    assert r["ok"], r
+    r2 = replay_scenario("stale_guard_restart", str(tmp_path / "shim"),
+                         shim=True)
+    assert not r2["ok"], r2
+    assert r2["detail"]["state"] == "queued"  # workers died instantly
+
+
+def test_unknown_scenario_raises(tmp_path):
+    with pytest.raises(KeyError):
+        replay_scenario("nope", str(tmp_path))

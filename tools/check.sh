@@ -29,6 +29,9 @@ JAX_PLATFORMS=cpu python tools/fault_smoke.py
 echo "== graftpulse: anomaly-capture + watchdog-bundle smoke (docs/OBSERVABILITY.md) =="
 JAX_PLATFORMS=cpu python tools/pulse_smoke.py
 
+echo "== graftwarden: deterministic race-replay smoke (docs/LINT.md) =="
+JAX_PLATFORMS=cpu python tools/race_smoke.py
+
 echo "== graftserve: kill-restart-replay + overload smoke (docs/SERVING.md) =="
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
 

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""graftwarden race-replay smoke — CI's warden-smoke job (docs/LINT.md).
+
+Replays the three races PR 6 fixed by hand, each under its SR_RACE_PLAN
+deterministic context-switch schedule (lint/racecheck.py), twice:
+
+1. on CURRENT code — the invariant must hold (ok=True);
+2. on a minimal revert shim of the historical fix — the same schedule
+   must now expose the bug (ok=False). A replay that passes either way
+   pins nothing, so the shim leg is what makes this a regression gate.
+
+Runs on CPU in a few minutes (two legs drive a real mini search). Exits
+nonzero on any unexpected outcome.
+
+    JAX_PLATFORMS=cpu python tools/race_smoke.py [workdir]
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from symbolicregression_jl_tpu.lint.racecheck import (  # noqa: E402
+    SCENARIOS,
+    replay_scenario,
+)
+
+
+def main() -> int:
+    workdir = sys.argv[1] if len(sys.argv) > 1 else None
+    ctx = (tempfile.TemporaryDirectory() if workdir is None
+           else _Keep(workdir))
+    failures = []
+    with ctx as base:
+        for name in SCENARIOS:
+            for shim in (False, True):
+                leg = "shim" if shim else "current"
+                root = os.path.join(base, f"{name}-{leg}")
+                r = replay_scenario(name, root, shim=shim)
+                expect_ok = not shim
+                status = "PASS" if r["ok"] == expect_ok else "FAIL"
+                print(f"[race_smoke] {status} {name} ({leg}): "
+                      f"ok={r['ok']} detail={json.dumps(r['detail'])}")
+                if r["ok"] != expect_ok:
+                    failures.append(f"{name}/{leg}")
+    if failures:
+        print(f"[race_smoke] FAILED: {', '.join(failures)}",
+              file=sys.stderr)
+        return 1
+    print(f"[race_smoke] OK: {len(SCENARIOS)} scenarios x "
+          f"(current passes, reverted shim detected)")
+    return 0
+
+
+class _Keep:
+    """Context manager keeping an explicit workdir (CI artifacts)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = os.path.abspath(path)
+
+    def __enter__(self) -> str:
+        os.makedirs(self.path, exist_ok=True)
+        return self.path
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())
