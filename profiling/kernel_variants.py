@@ -14,6 +14,19 @@ semantically-degraded or restructured copies of the interpreter:
   nounroll   — no 2x pair unroll
   tb16/tb32  — tree_block 16/32 (X-copy + grid fixed costs amortized)
 
+Round-7 graftstage rows (docs/PRECISION.md) — these run the SHIPPED
+fused_loss_program, not the legacy A/B copy above:
+
+  prod       — production kernel, full dataset, f32
+  prodbf16   — production kernel, full dataset, bf16 row tiles
+               (`Options(eval_precision="bf16")` path)
+  screen[D]  — production kernel on the staged screening sample: the
+               strided 1/D row subset (default D=8, i.e. the default
+               staged_sample_fraction=0.125), f32. screen vs prod is
+               the measured screen:rescore per-launch cost ratio that
+               RESULTS.md round 7 holds against the dispatch-floor
+               model.
+
 Usage: kernel_variants.py [T] [which...]
 """
 
@@ -37,7 +50,8 @@ from jax.experimental.pallas import tpu as pltpu
 from _common import make_bench_problem
 
 from symbolicregression_jl_tpu.ops.fused_eval import (
-    _merged_branches, _pick_tile, _round_up, _unpack, fused_loss_program)
+    _merged_branches, _pick_tile, _round_up, _unpack, fused_loss_program,
+    strided_sample_indices)
 
 
 def _pack_instr(prog):
@@ -279,7 +293,8 @@ def main():
     T = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
     S = int(os.environ.get("STEPS", "8"))
     which = sys.argv[2:] or ["base", "noswitch", "novmask", "cond2",
-                             "signmerge", "nounroll", "tb16", "tb32"]
+                             "signmerge", "nounroll", "tb16", "tb32",
+                             "prod", "prodbf16", "screen"]
 
     options, ds, engine = make_bench_problem()
     cfg = engine.cfg
@@ -302,12 +317,27 @@ def main():
         elif v == "combo":
             tb = 16
 
-        if v == "prod":
+        if v in ("prod", "prodbf16") or v.startswith("screen"):
+            # Shipped-kernel rows (round 7): full-row f32 / bf16 tiles,
+            # and the staged screening launch on the strided row sample.
+            Xv, yv = X, y
+            if v.startswith("screen"):
+                denom = int(v[len("screen"):] or "8")
+                n = int(X.shape[1])
+                k = max(64, n // denom)
+                idx = jnp.asarray(strided_sample_indices(n, k))
+                Xv = jnp.take(X, idx, axis=1)
+                yv = jnp.take(y, idx)
+
+            interp = jax.default_backend() != "tpu"
+
             @jax.jit
-            def step_fn(p):
+            def step_fn(p, Xv=Xv, yv=yv, bf=(v == "prodbf16"),
+                        interp=interp):
                 loss, valid = fused_loss_program(
-                    p, X, y, None, F, cfg.operators,
-                    options.elementwise_loss)
+                    p, Xv, yv, None, F, cfg.operators,
+                    options.elementwise_loss, bf16=bf,
+                    interpret=interp)
                 eps = jnp.nanmin(
                     jnp.where(jnp.isfinite(loss), loss, jnp.inf))
                 return dataclasses.replace(

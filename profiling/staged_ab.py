@@ -1,0 +1,113 @@
+"""graftstage A/B on the 10k-row headline problem (docs/PRECISION.md).
+
+Runs the bench.py headline problem (10k rows, 5 features, the reference
+target) twice at a CPU-feasible scale — staged eval OFF then ON — and
+prints per-run evals/s plus the screen/rescore device counters, so the
+round-7 claim ("the plateau moves because fewer full-dataset rows are
+launched per cycle") is measured, not modeled. The scale knobs default
+small enough for a CPU workstation; on a chip, crank them toward the
+headline 512x256 config:
+
+    python profiling/staged_ab.py [islands] [pop] [ncycles] [iters]
+
+Candidate-eval accounting: ``num_evals`` counts CANDIDATE evaluations
+(each screened candidate counts once — the row-sample discount is what
+staging banks as throughput; the graftbench quality gate bounds what
+that trade may cost). The counters printed alongside make the row
+accounting explicit: screen_rows/rescore_rows are candidates through
+each launch, eval launch count doubles per staged cycle.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import _common  # noqa: F401  (repo root on sys.path)
+import numpy as np
+
+N_ROWS = 10_000
+N_FEATURES = 5
+
+
+def _make_data():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-3.0, 3.0, (N_ROWS, N_FEATURES)).astype(np.float32)
+    y = (
+        np.cos(2.13 * X[:, 0])
+        + 0.5 * X[:, 1] * np.abs(X[:, 2]) ** 0.9
+        - 0.3 * np.abs(X[:, 3]) ** 1.5
+        + 1e-1 * rng.standard_normal(N_ROWS)
+    ).astype(np.float32)
+    return X, y
+
+
+def _run(staged: bool, islands: int, pop: int, ncycles: int,
+         iters: int) -> dict:
+    import jax
+
+    from symbolicregression_jl_tpu import Options, search_key
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.evolve.engine import Engine
+
+    X, y = _make_data()
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["exp", "abs", "cos"],
+        maxsize=30,
+        populations=islands,
+        population_size=pop,
+        tournament_selection_n=min(16, pop // 2),
+        ncycles_per_iteration=ncycles,
+        save_to_file=False,
+        staged_eval=staged,
+        telemetry=True,
+    )
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(options.elementwise_loss)
+    engine = Engine(options, ds.nfeatures)
+    state = engine.init_state(search_key(0), ds.data, islands)
+
+    # warmup/compile iteration, excluded from timing
+    state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    ev0 = float(state.num_evals)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = engine.run_iteration(state, ds.data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    dt = time.perf_counter() - t0
+
+    t = state.telem.cycle
+    return {
+        "staged": staged,
+        "evals": float(state.num_evals) - ev0,
+        "elapsed_s": round(dt, 3),
+        "evals_per_sec": round((float(state.num_evals) - ev0) / dt, 1),
+        # per-iteration device counters (last iteration's snapshot)
+        "screen_rows": int(t.screen_rows),
+        "rescore_rows": int(t.rescore_rows),
+        "eval_rows": int(t.eval_rows),
+        "eval_launches": int(t.eval_launches),
+        "best_loss": float(jax.numpy.min(state.hof.loss)),
+    }
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    islands = int(argv[0]) if len(argv) > 0 else 8
+    pop = int(argv[1]) if len(argv) > 1 else 32
+    ncycles = int(argv[2]) if len(argv) > 2 else 10
+    iters = int(argv[3]) if len(argv) > 3 else 2
+
+    off = _run(False, islands, pop, ncycles, iters)
+    on = _run(True, islands, pop, ncycles, iters)
+    ratio = on["evals_per_sec"] / max(off["evals_per_sec"], 1e-9)
+    print(json.dumps({"plain": off, "staged": on,
+                      "staged_over_plain": round(ratio, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -998,6 +998,10 @@ def equation_search(
         hub.add_sink(AnomalyDetector(
             hub,
             on_anomaly=(pulse_cap.arm if pulse_cap is not None else None),
+            expected_rescore_fraction=(
+                float(getattr(options, "rescore_fraction", 0.0))
+                if getattr(options, "staged_eval", False) else None
+            ),
         ))
 
     # ---- graftledger cost account (ledger/ledger.py) ----

@@ -39,8 +39,13 @@ FULL = dict(rows=10_000, populations=512,
 # "sharded" = legacy GSPMD island sharding; "sharded-mesh" = the same
 # problem/shapes on the graftmesh shard_map runtime (mesh/MeshEngine,
 # per-shard finalize-dedup, explicit collectives) so mesh perf/quality
-# is gated from day one (docs/SCALING.md).
-VARIANTS = ("plain", "template", "parametric", "sharded", "sharded-mesh")
+# is gated from day one (docs/SCALING.md). The "plain-staged" /
+# "plain-bf16" / "plain-staged-bf16" variants are the plain cell with
+# the graftstage modes on (docs/PRECISION.md) — same problem, same
+# shapes, so their quality gates measure exactly what staging/bf16
+# trade away.
+VARIANTS = ("plain", "template", "parametric", "sharded", "sharded-mesh",
+            "plain-staged", "plain-bf16", "plain-staged-bf16")
 
 
 def _problem(shape: Dict[str, Any], variant: str):
@@ -79,6 +84,8 @@ def _options(shape: Dict[str, Any], variant: str, out_dir: str):
         )
     elif variant == "parametric":
         spec = ParametricExpressionSpec(max_parameters=1)
+    staged = variant in ("plain-staged", "plain-staged-bf16")
+    bf16 = variant in ("plain-bf16", "plain-staged-bf16")
     return Options(
         binary_operators=["+", "-", "*"],
         unary_operators=["cos"],
@@ -91,6 +98,8 @@ def _options(shape: Dict[str, Any], variant: str, out_dir: str):
         expression_spec=spec,
         output_directory=out_dir,
         telemetry=True,
+        eval_precision="bf16" if bf16 else "f32",
+        staged_eval=staged,
     )
 
 

@@ -42,12 +42,31 @@ MEASURE_ITERS = 3
 
 
 def main() -> None:
+    import argparse
+
     import jax
 
     from .. import Options, search_key
     from ..core.dataset import make_dataset
     from ..evolve.engine import Engine
+    from ..evolve.step import resolve_sample_rows
     from ..telemetry.schema import SCHEMA_VERSION
+
+    # graftstage A/B knobs (docs/PRECISION.md): the headline defaults to
+    # the committed f32/full-eval config; --staged / --bf16 measure the
+    # staged sample-then-rescore path and the bf16 row tiles on the same
+    # problem. The emitted provenance block always records which mode
+    # produced the number.
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--staged", action="store_true",
+                    help="staged sample-then-rescore candidate eval")
+    ap.add_argument("--bf16", action="store_true",
+                    help="bf16 eval row tiles (f32 reduction spine)")
+    ap.add_argument("--sample-fraction", type=float, default=0.125,
+                    help="screening sample fraction (staged mode)")
+    ap.add_argument("--rescore-fraction", type=float, default=0.25,
+                    help="full-eval rescore fraction (staged mode)")
+    args = ap.parse_args()
 
     rng = np.random.default_rng(0)
     X = rng.uniform(-3.0, 3.0, (N_ROWS, N_FEATURES)).astype(np.float32)
@@ -74,6 +93,10 @@ def main() -> None:
         tournament_selection_n=16,
         ncycles_per_iteration=100,
         save_to_file=False,
+        eval_precision="bf16" if args.bf16 else "f32",
+        staged_eval=args.staged,
+        staged_sample_fraction=args.sample_fraction,
+        rescore_fraction=args.rescore_fraction,
     )
     ds = make_dataset(X, y)
     ds.update_baseline_loss(options.elementwise_loss)
@@ -125,6 +148,17 @@ def main() -> None:
         "fuse_cost_epilogue": bool(engine.cfg.fuse_cost),
         "eval_tree_block": engine.cfg.eval_tree_block,
         "eval_tile_rows": engine.cfg.eval_tile_rows,
+        # graftstage provenance (round 7, docs/PRECISION.md): the eval
+        # precision and staging geometry behind the number — BENCH_r0*
+        # artifacts stay self-describing across the new variants.
+        "eval_precision": "bf16" if engine.cfg.eval_bf16 else "f32",
+        "staged_eval": bool(engine.cfg.staged_eval),
+        "staged_sample_rows": (
+            resolve_sample_rows(engine.cfg, N_ROWS)
+            if engine.cfg.staged_eval else None),
+        "rescore_fraction": (
+            engine.cfg.rescore_fraction
+            if engine.cfg.staged_eval else None),
         # graftscope provenance (round 7): whether the device counters
         # rode the measured iterations (they are off for the headline —
         # the bench measures the bare hot loop) and the schema version a

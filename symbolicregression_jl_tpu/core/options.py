@@ -15,13 +15,35 @@ mutation-weight vectors) are derived once per search.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional, Sequence,
+                    Tuple, Union)
 
 import numpy as np
 
 from ..ops.operators import DEFAULT_BINARY, DEFAULT_UNARY, Op, OperatorSet
 
-__all__ = ["MutationWeights", "ComplexityMapping", "Options", "MUTATION_KINDS"]
+__all__ = ["MutationWeights", "ComplexityMapping", "Options", "MUTATION_KINDS",
+           "EvalGeometry", "KERNEL_TREE_BLOCK", "KERNEL_TILE_ROWS"]
+
+# Candidate-eval kernel launch-geometry defaults (ops/fused_eval.py's
+# fused_cost/fused_loss wrappers). These are THE defaults: every layer
+# that needs resolved geometry goes through Options.eval_geometry()
+# instead of re-spelling a `x if x is not None else N` fallback chain.
+KERNEL_TREE_BLOCK = 8
+KERNEL_TILE_ROWS = 16384
+
+
+class EvalGeometry(NamedTuple):
+    """Resolved candidate-eval kernel launch geometry.
+
+    The single source of the kernel-geometry fallback (tree_block=8,
+    tile_rows=16384): evolve/step.py, evolve/engine.py and the bench
+    provenance all resolve unset Options knobs through
+    :meth:`Options.eval_geometry` rather than forking their own
+    `getattr(...) or default` chains."""
+
+    tree_block: int = KERNEL_TREE_BLOCK
+    tile_rows: int = KERNEL_TILE_ROWS
 
 
 # Order matters: it defines the integer encoding of mutation kinds used on
@@ -329,6 +351,26 @@ class Options:
         # on; False keeps the materializing post-kernel arithmetic
         # (A/B profiling — profiling/cycle_attrib.py).
         fuse_cost_epilogue: Optional[bool] = None,
+        # graftstage (docs/PRECISION.md): the two engine modes that trade
+        # exactness for throughput, both default OFF — the f32/full path
+        # is bit-identical with them off.
+        # `eval_precision`: "f32" (exact) or "bf16" (candidate evals run
+        # the kernel's bfloat16 row tiles with an f32 reduction spine for
+        # the loss/cost epilogue; quality-gated, not bit-exact).
+        eval_precision: str = "f32",
+        # Staged sample-then-rescore candidate evaluation: screen every
+        # candidate on a deterministic strided row sample, then re-score
+        # only the top `rescore_fraction` on the full dataset; candidates
+        # outside the rescore set are rejected (parents kept), so
+        # acceptance, HoF updates, and finalize consume only
+        # fully-rescored costs. `staged_sample_rows` pins the sample
+        # size; None derives it as `staged_sample_fraction` of the
+        # dataset (floored at 64 rows, capped by eval_tile_rows — the
+        # shield degrade ladder keeps that cap as it steps tiles down).
+        staged_eval: bool = False,
+        staged_sample_rows: Optional[int] = None,
+        staged_sample_fraction: float = 0.125,
+        rescore_fraction: float = 0.25,
         bumper: bool = False,  # accepted for API parity (no allocator to tune)
         autodiff_backend=None,  # ignored: gradients always via jax.grad
         # 12. Determinism
@@ -563,6 +605,13 @@ class Options:
             None if eval_tile_rows is None else int(eval_tile_rows)
         )
         self.fuse_cost_epilogue = fuse_cost_epilogue  # tri-state
+        self.eval_precision = str(eval_precision)
+        self.staged_eval = bool(staged_eval)
+        self.staged_sample_rows = (
+            None if staged_sample_rows is None else int(staged_sample_rows)
+        )
+        self.staged_sample_fraction = float(staged_sample_fraction)
+        self.rescore_fraction = float(rescore_fraction)
         self.bumper = bool(bumper)
         self.autodiff_backend = autodiff_backend
 
@@ -610,6 +659,15 @@ class Options:
             raise ValueError("eval_tree_block must be positive")
         if self.eval_tile_rows is not None and self.eval_tile_rows <= 0:
             raise ValueError("eval_tile_rows must be positive")
+        if self.eval_precision not in ("f32", "bf16"):
+            raise ValueError('eval_precision must be "f32" or "bf16"')
+        if (self.staged_sample_rows is not None
+                and self.staged_sample_rows <= 0):
+            raise ValueError("staged_sample_rows must be positive (or None)")
+        if not (0.0 < self.staged_sample_fraction <= 1.0):
+            raise ValueError("staged_sample_fraction must be in (0, 1]")
+        if not (0.0 < self.rescore_fraction <= 1.0):
+            raise ValueError("rescore_fraction must be in (0, 1]")
         if self.telemetry_interval < 1:
             raise ValueError("telemetry_interval must be >= 1")
         if self.checkpoint_keep < 1:
@@ -626,6 +684,17 @@ class Options:
             v = getattr(self, name)
             if v is not None and v <= 0:
                 raise ValueError(f"{name} must be positive (or None)")
+
+    def eval_geometry(self) -> EvalGeometry:
+        """Candidate-eval kernel launch geometry with the kernel defaults
+        resolved — the one fallback chain for `eval_tree_block` /
+        `eval_tile_rows` (see :class:`EvalGeometry`)."""
+        return EvalGeometry(
+            tree_block=(self.eval_tree_block
+                        if self.eval_tree_block else KERNEL_TREE_BLOCK),
+            tile_rows=(self.eval_tile_rows
+                       if self.eval_tile_rows else KERNEL_TILE_ROWS),
+        )
 
     @property
     def nops(self):

@@ -180,6 +180,7 @@ class Engine:
                 tree_block=self.cfg.eval_tree_block,
                 tile_rows=self.cfg.eval_tile_rows,
                 fuse_cost=self.cfg.fuse_cost,
+                bf16=self.cfg.eval_bf16,
             )
 
         self._eval_cost = jax.jit(eval_cost_flat)
@@ -334,6 +335,7 @@ class Engine:
                 tree_block=cfg.eval_tree_block,
                 tile_rows=cfg.eval_tile_rows,
                 fuse_cost=cfg.fuse_cost,
+                bf16=cfg.eval_bf16,
             )
         )(trees, params)
 
@@ -805,6 +807,7 @@ class Engine:
                 template=cfg.template, dedup=True,
                 tree_block=cfg.eval_tree_block,
                 tile_rows=cfg.eval_tile_rows,
+                bf16=cfg.eval_bf16,
             )
             cost, loss, cx = (cost.reshape(I, P), loss.reshape(I, P),
                               cx.reshape(I, P))
@@ -821,6 +824,7 @@ class Engine:
                     tree_block=cfg.eval_tree_block,
                     tile_rows=cfg.eval_tile_rows,
                     fuse_cost=cfg.fuse_cost,
+                    bf16=cfg.eval_bf16,
                 )
             )(pops.trees, pops.params)
         return dataclasses.replace(pops, cost=cost, loss=loss,

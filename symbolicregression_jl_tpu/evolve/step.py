@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.losses import aggregate_loss, loss_to_cost
-from ..core.options import MUTATION_KINDS, Options
+from ..core.options import (KERNEL_TILE_ROWS, KERNEL_TREE_BLOCK,
+                            MUTATION_KINDS, Options)
 from ..ops.complexity import (
     ComplexityTables,
     check_constraints_batch,
@@ -137,6 +138,19 @@ class EvolveConfig(NamedTuple):
     eval_tree_block: int = 8
     eval_tile_rows: int = 16384
     fuse_cost: bool = False
+    # graftstage (docs/PRECISION.md): bf16 candidate-eval row tiles (f32
+    # reduction spine) and the staged sample-then-rescore evaluation
+    # path. Both default off; the f32/full path is bit-identical with
+    # them off. ``staged_sample_rows`` = 0 derives the screening sample
+    # as ``staged_sample_fraction`` of the dataset (see
+    # ``resolve_sample_rows``); the resolver caps it at
+    # ``eval_tile_rows`` so the shield degrade ladder's tile step-down
+    # keeps the sample inside one row tile.
+    eval_bf16: bool = False
+    staged_eval: bool = False
+    staged_sample_rows: int = 0
+    staged_sample_fraction: float = 0.125
+    rescore_fraction: float = 0.25
     # graftscope device counters (options.telemetry): generation_step
     # emits a CycleTelemetry from values it already computed, s_r_cycle
     # accumulates it in the scan carry — the search trajectory is
@@ -198,6 +212,7 @@ def evolve_config_from_options(options: Options, nfeatures: int,
     # template/parametric kernels launch per-device on local islands
     # exactly like the plain-expression kernels. Covered by
     # tests/test_sharded_turbo.py and __graft_entry__.dryrun_multichip.)
+    geom = options.eval_geometry()
     return EvolveConfig(
         operators=options.operators,
         maxsize=options.maxsize,
@@ -235,8 +250,11 @@ def evolve_config_from_options(options: Options, nfeatures: int,
         template=template,
         record_events=bool(getattr(options, "use_recorder", False)),
         n_islands=max(1, options.populations // max(n_island_shards, 1)),
-        eval_tree_block=getattr(options, "eval_tree_block", None) or 8,
-        eval_tile_rows=getattr(options, "eval_tile_rows", None) or 16384,
+        # Geometry defaults resolve in ONE place (Options.eval_geometry);
+        # checkpointed Options predating the resolver fall back to its
+        # defaults through the same path.
+        eval_tree_block=geom.tree_block,
+        eval_tile_rows=geom.tile_rows,
         # In-kernel loss->cost epilogue: auto-on with turbo (the fused
         # kernel is the only place the epilogue can live); tri-state
         # override for A/B measurement.
@@ -244,6 +262,14 @@ def evolve_config_from_options(options: Options, nfeatures: int,
             getattr(options, "fuse_cost_epilogue", None) is not False
         ),
         collect_telemetry=bool(getattr(options, "telemetry", False)),
+        # graftstage knobs (getattr: unpickled pre-graftstage Options
+        # carry neither attribute; both modes default off there).
+        eval_bf16=getattr(options, "eval_precision", "f32") == "bf16",
+        staged_eval=bool(getattr(options, "staged_eval", False)),
+        staged_sample_rows=getattr(options, "staged_sample_rows", None) or 0,
+        staged_sample_fraction=float(
+            getattr(options, "staged_sample_fraction", 0.125)),
+        rescore_fraction=float(getattr(options, "rescore_fraction", 0.25)),
     )
 
 
@@ -436,7 +462,7 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
                     turbo=False, interpret=False, loss_function=None,
                     dim_penalty=1000.0, wildcard_constants=True,
                     template=None, dedup=False, tree_block=None,
-                    tile_rows=None, fuse_cost=False):
+                    tile_rows=None, fuse_cost=False, bf16=False):
     """Batched eval_cost (src/LossFunctions.jl:193-209): (cost, loss, complexity).
 
     ``turbo`` routes through the fused Pallas eval+loss kernel (the hot
@@ -454,6 +480,13 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
     callers keep the materializing epilogue, gated exactly like turbo.
     ``tree_block`` / ``tile_rows`` override the fused kernel's launch
     geometry (options.eval_tree_block / eval_tile_rows).
+
+    ``bf16`` (options.eval_precision == "bf16") evaluates the row tiles
+    in bfloat16 with a float32 reduction spine for loss/cost — rank-
+    reliable but not bit-exact vs f32 (docs/PRECISION.md). Applied on
+    both the fused kernel and the jnp interpreter fallback so CPU bench
+    cells exercise the same numeric contract; template/parametric/
+    custom-loss paths stay f32.
     """
     if batch_idx is None:
         X = data.Xt
@@ -510,8 +543,8 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
         raise ValueError(
             "Parametric evaluation requires a `class` column in the dataset"
         )
-    tb = tree_block if tree_block is not None else 8
-    tr = tile_rows if tile_rows is not None else 16384
+    tb = tree_block if tree_block is not None else KERNEL_TREE_BLOCK
+    tr = tile_rows if tile_rows is not None else KERNEL_TILE_ROWS
     fused_cost_path = (
         turbo and fuse_cost and loss_function is None and not has_params
         and not dedup
@@ -525,7 +558,7 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
             trees, X, y, w, complexity, operators, elementwise_loss,
             baseline_loss=data.baseline_loss,
             use_baseline=data.use_baseline, parsimony=parsimony,
-            tree_block=tb, tile_rows=tr, interpret=interpret,
+            tree_block=tb, tile_rows=tr, interpret=interpret, bf16=bf16,
         )
     elif turbo and loss_function is None:
         # Parametric members ride the fused kernel too: their banks
@@ -536,14 +569,25 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
             params=member_params if has_params else None,
             class_idx=class_idx if has_params else None,
             tree_block=tb, tile_rows=tr,
-            interpret=interpret, dedup=dedup,
+            interpret=interpret, dedup=dedup, bf16=bf16,
         )
     else:
         params = (
             jnp.take(member_params, class_idx, axis=-1)  # [..., K, n]
             if has_params else None
         )
-        pred, valid = eval_tree_batch(trees, X, operators, params=params)
+        if bf16 and loss_function is None and not has_params:
+            # Interpreter-path mirror of the kernel's bf16 row tiles
+            # (bf16 value storage, f32 loss reduction): cast X and the
+            # constant bank so the eval buffer dtype is bfloat16, then
+            # upcast predictions before the loss epilogue.
+            trees_b = dataclasses.replace(
+                trees, const=trees.const.astype(jnp.bfloat16))
+            pred, valid = eval_tree_batch(
+                trees_b, X.astype(jnp.bfloat16), operators, params=None)
+            pred = pred.astype(jnp.float32)
+        else:
+            pred, valid = eval_tree_batch(trees, X, operators, params=params)
         loss = _loss_from_pred(pred, valid)
     if not fused_cost_path:
         complexity = compute_complexity_batch(trees, tables)
@@ -564,6 +608,44 @@ def eval_cost_batch(trees: TreeBatch, data, elementwise_loss, tables,
         )
         cost = cost + jnp.asarray(dim_penalty, cost.dtype) * viol
     return cost, loss, complexity
+
+
+# ---------------------------------------------------------------------------
+# graftstage: staged sample-then-rescore evaluation (docs/PRECISION.md)
+# ---------------------------------------------------------------------------
+
+#: Floor for the screening sample — below this the screen's cost ranking
+#: is too noisy to be worth a second launch.
+MIN_SAMPLE_ROWS = 64
+
+
+def resolve_sample_rows(cfg: EvolveConfig, n_rows: int) -> int:
+    """Static screening-sample size for the staged eval path.
+
+    Explicit ``staged_sample_rows`` wins; otherwise the sample is
+    ``staged_sample_fraction`` of the dataset (or minibatch). The result
+    is floored at MIN_SAMPLE_ROWS and capped at both the dataset size and
+    ``cfg.eval_tile_rows`` — the latter is the shield degrade ladder
+    contract: when ``degrade_eval_tile_rows`` halves the tile, the
+    screening sample steps down with it so the screen launch never spans
+    more than one row tile (tests/test_staged_eval.py).
+    """
+    if cfg.staged_sample_rows > 0:
+        k = int(cfg.staged_sample_rows)
+    else:
+        k = int(-(-n_rows * cfg.staged_sample_fraction // 1))
+    k = max(MIN_SAMPLE_ROWS, k)
+    k = min(k, int(n_rows))
+    if cfg.eval_tile_rows:
+        k = min(k, int(cfg.eval_tile_rows))
+    return max(1, k)
+
+
+def rescore_count(cfg: EvolveConfig, n_candidates: int) -> int:
+    """Static number of screened candidates promoted to the full-dataset
+    rescore launch: ceil(rescore_fraction * N), at least 1."""
+    r = int(-(-n_candidates * cfg.rescore_fraction // 1))
+    return max(1, min(int(n_candidates), r))
 
 
 # ---------------------------------------------------------------------------
@@ -838,18 +920,88 @@ def generation_step(
             B * p_x + 3.0 * _math.sqrt(B * p_x * (1.0 - p_x)) + 1.0
         )))
 
-    def _eval(trees, params):
+    def _eval_on(trees, params, idx):
         return eval_cost_batch(
             trees, data, elementwise_loss, tables, cfg.operators,
-            cfg.parsimony, batch_idx=batch_idx, member_params=params,
+            cfg.parsimony, batch_idx=idx, member_params=params,
             turbo=cfg.turbo, interpret=cfg.interpret,
             loss_function=options.resolved_loss_function,
             dim_penalty=cfg.dim_penalty,
             wildcard_constants=cfg.wildcard_constants,
             template=cfg.template,
             tree_block=cfg.eval_tree_block, tile_rows=cfg.eval_tile_rows,
-            fuse_cost=cfg.fuse_cost,
+            fuse_cost=cfg.fuse_cost, bf16=cfg.eval_bf16,
         )
+
+    # graftstage staged path (docs/PRECISION.md): screen every candidate
+    # on a deterministic strided row sample, then rescore only the top
+    # rescore_fraction on the full row set. Acceptance and the HoF
+    # consume only fully-rescored costs — unrescored candidates carry
+    # NaN cost, which both the mutation acceptance (~isnan below) and
+    # the crossover xo_nan rejection treat as "candidate failed, keep
+    # the parent", so no sample-estimated cost ever enters the
+    # population. Row selection reuses the serve overload ladder's
+    # strided shed (replay-stable, no RNG).
+    n_data_rows = (int(batch_idx.shape[0]) if batch_idx is not None
+                   else int(data.y.shape[0]))
+    staged = (cfg.staged_eval and cfg.template is None
+              and options.resolved_loss_function is None)
+    sample_rows = resolve_sample_rows(cfg, n_data_rows) if staged else 0
+    staged = staged and sample_rows < n_data_rows
+
+    if staged:
+        from ..ops.fused_eval import strided_sample_indices
+
+        strided = jnp.asarray(
+            strided_sample_indices(n_data_rows, sample_rows))
+        screen_idx = (strided if batch_idx is None
+                      else jnp.take(batch_idx, strided))
+
+        def _eval(trees, params):
+            bshape = trees.batch_shape
+            flat = trees.reshape(-1)
+            N = flat.length.shape[0]
+            p_flat = params.reshape((N,) + params.shape[len(bshape):])
+            # 1) screen: every candidate, sample rows only.
+            c_s, l_s, x_s = _eval_on(flat, p_flat, screen_idx)
+            R = rescore_count(cfg, N)
+            # 2) pack the top-R screened candidates (NaN screens rank
+            # last) via the one-hot matmul row-take, exactly like the
+            # crossover pool below.
+            score = jnp.where(jnp.isnan(c_s), jnp.inf, c_s)
+            _, sel_r = jax.lax.top_k(-score, R)
+            oh_r = jax.nn.one_hot(sel_r, N, dtype=flat.const.dtype)
+            sel_trees = TreeBatch(
+                arity=_onehot_rows_i(oh_r, flat.arity),
+                op=_onehot_rows_i(oh_r, flat.op),
+                feat=_onehot_rows_i(oh_r, flat.feat),
+                const=_onehot_rows_f(oh_r, flat.const),
+                length=_onehot_rows_i(oh_r, flat.length),
+            )
+            sel_params = _onehot_rows_f(oh_r, p_flat)
+            # The float gather clamps non-finite sources; track rows
+            # whose raw genome was bad so their NaN verdict survives
+            # the rescore (same contract as the pool's slot_bad2).
+            row_bad = (
+                ~jnp.all(jnp.isfinite(flat.const.reshape(N, -1)), axis=1)
+                | ~jnp.all(jnp.isfinite(p_flat.reshape(N, -1)), axis=1)
+            )
+            # 3) rescore on the full row set (or the cycle minibatch).
+            c_r, l_r, _ = _eval_on(sel_trees, sel_params, batch_idx)
+            bad_sel = jnp.take(row_bad, sel_r)
+            c_r = jnp.where(bad_sel, jnp.nan, c_r)
+            l_r = jnp.where(bad_sel, jnp.asarray(jnp.inf, l_r.dtype), l_r)
+            # 4) scatter back; unrescored candidates stay NaN-cost.
+            # Complexity is row-count independent — the screen's value
+            # is exact for every candidate.
+            cost = jnp.full((N,), jnp.nan, c_r.dtype).at[sel_r].set(c_r)
+            loss = jnp.full(
+                (N,), jnp.inf, l_r.dtype).at[sel_r].set(l_r)
+            return (cost.reshape(bshape), loss.reshape(bshape),
+                    x_s.reshape(bshape))
+    else:
+        def _eval(trees, params):
+            return _eval_on(trees, params, batch_idx)
 
     if 0 < k2 < B:
         _, sel2 = jax.lax.top_k(is_xover.astype(jnp.float32), k2)
@@ -1013,6 +1165,9 @@ def generation_step(
             after_cost=after_cost, xo_nan=xo_nan, anneal_ok=anneal_ok,
             cost=cost, needs_eval1=needs_eval1, needs_eval2=needs_eval2,
             n_eval_rows=n_eval_rows,
+            n_screen_rows=n_eval_rows if staged else 0,
+            n_rescore_rows=(rescore_count(cfg, n_eval_rows)
+                            if staged else 0),
         )
 
     replace1 = jnp.where(is_xover, xo_replace, mut_replace)

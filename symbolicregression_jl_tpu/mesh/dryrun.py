@@ -53,6 +53,7 @@ def _legs(fast: bool):
     legs = [("mesh-jnp", _leg_jnp)]
     if not fast:
         legs += [("mesh-turbo-dedup", _leg_turbo_dedup),
+                 ("mesh-staged", _leg_staged),
                  ("mesh-aot", _leg_aot),
                  ("legacy-turbo", _leg_legacy_turbo),
                  ("legacy-template", _leg_legacy_template),
@@ -106,7 +107,8 @@ def virtual_cpu_mesh_env(n_devices: int, base_env=None) -> Dict[str, str]:
     return env
 
 
-def _options(n_island_shards: int, turbo: bool, expression_spec=None):
+def _options(n_island_shards: int, turbo: bool, expression_spec=None,
+             **extra):
     from ..core.options import Options
 
     return Options(
@@ -129,10 +131,12 @@ def _options(n_island_shards: int, turbo: bool, expression_spec=None):
         fraction_replaced=0.3,
         save_to_file=False,
         turbo=turbo,
+        **extra,
     )
 
 
-def _build(n_island_shards: int, turbo: bool, sharded_dedup: bool = True):
+def _build(n_island_shards: int, turbo: bool, sharded_dedup: bool = True,
+           **opt_extra):
     import jax
 
     from ..core.dataset import make_dataset
@@ -141,7 +145,7 @@ def _build(n_island_shards: int, turbo: bool, sharded_dedup: bool = True):
 
     from .. import search_key
 
-    options = _options(n_island_shards, turbo)
+    options = _options(n_island_shards, turbo, **opt_extra)
     X, y = make_dryrun_problem(512)
     ds = make_dataset(X, y)
     ds.update_baseline_loss(options.elementwise_loss)
@@ -240,6 +244,28 @@ def _leg_jnp(n_devices: int) -> None:
     import jax
 
     engine, state, data, options = _build(n_devices, turbo=False)
+    for _ in range(2):
+        state = engine.run_iteration(state, data, options.maxsize)
+    jax.block_until_ready(state.pops.cost)
+    _check_populations(state, options)
+    if n_devices > 1:
+        _check_migration_mixed(state, options, n_devices)
+
+
+def _leg_staged(n_devices: int) -> None:
+    """graftstage on the mesh runtime (docs/PRECISION.md): staged
+    sample-then-rescore candidate eval inside shard_map. The population
+    checks below pin the staged contract — every population/HoF cost is
+    a finite FULL-dataset value (no NaN-cost unrescored candidate ever
+    replaced a parent), migration still mixes across shards."""
+    import jax
+
+    engine, state, data, options = _build(
+        n_devices, turbo=True,
+        staged_eval=True, staged_sample_fraction=0.25,
+        rescore_fraction=0.3,
+    )
+    assert engine.cfg.staged_eval, "staged leg must run the staged path"
     for _ in range(2):
         state = engine.run_iteration(state, data, options.maxsize)
     jax.block_until_ready(state.pops.cost)

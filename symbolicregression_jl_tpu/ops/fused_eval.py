@@ -46,7 +46,7 @@ __all__ = ["fused_loss", "fused_loss_program", "fused_loss_multi",
            "fused_grad_program", "fused_grad_multi",
            "fused_loss_and_const_grad", "fused_predict",
            "fused_predict_program", "fused_predict_ad",
-           "supports_fused_eval"]
+           "supports_fused_eval", "strided_sample_indices"]
 
 
 def _round_up(x: int, m: int) -> int:
@@ -71,6 +71,23 @@ def _pick_tile(n: int, tile_cap: int, vmem_rows: int, bytes_per: int,
 def supports_fused_eval(operators: OperatorSet) -> bool:
     """The kernel handles arity <= 2 operator sets (current encoding)."""
     return all(d in (1, 2) for d in operators.ops.keys())
+
+
+def strided_sample_indices(n_rows: int, sample_rows: int) -> np.ndarray:
+    """[sample_rows] int32 row indices for the graftstage screening
+    launch: an even stride over the dataset, ``(k * n) // sample_rows``.
+
+    This is the SAME selection the serve overload ladder's sample-shed
+    uses (serve/server.py) — deterministic in (n_rows, sample_rows),
+    no RNG — so staged screening is replay-stable: a journal replay or
+    checkpoint resume re-derives the identical sample from the shapes
+    alone. Host-side (static under jit: callers bake the constant into
+    the traced program)."""
+    k = int(min(sample_rows, n_rows))
+    if k <= 0:
+        raise ValueError("sample_rows must be positive")
+    return ((np.arange(k, dtype=np.int64) * int(n_rows)) // k).astype(
+        np.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -397,9 +414,16 @@ def _make_program_kernel(
         tile = y_row.shape[0]
         L = instr_ref.shape[-1]
 
+        # The value buffer may be bfloat16 (graftstage eval_precision,
+        # docs/PRECISION.md): steps then COMPUTE in f32 (_fwd_dispatch
+        # upcasts on read) and downcast at the buffer store, while y/w
+        # and the loss/cost accumulators keep the operand dtype — the
+        # f32 reduction spine. With an f32 buffer every astype below is
+        # a no-op, keeping that path bit-identical.
+        bdt = buf_ref.dtype
         buf_ref[0:nfeat, :] = x_ref[...]
         if _dispatch_plan(operators).merged:
-            buf_ref[BASE + L, :] = jnp.zeros((tile,), y_row.dtype)
+            buf_ref[BASE + L, :] = jnp.zeros((tile,), bdt)
 
         for t in range(tree_block):
             if nparam > 0:
@@ -412,7 +436,7 @@ def _make_program_kernel(
                     for c in range(1, nclass):
                         row = row + (clsoh_ref[c, :]
                                      * pbank_ref[t, p_i * nclass + c])
-                    buf_ref[nfeat + p_i, :] = row
+                    buf_ref[nfeat + p_i, :] = row.astype(bdt)
 
             # Static-unrolled const preload: at nconst == cmax the
             # dynamic fori_loop(0, nconst) costs ~420 ns/tree of scalar
@@ -422,13 +446,13 @@ def _make_program_kernel(
             # hold zero-padding and are never addressed.
             for c in range(cmax):
                 buf_ref[CBASE + c, :] = jnp.full(
-                    (tile,), cvals_ref[t, c], dtype=y_row.dtype)
+                    (tile,), cvals_ref[t, c], dtype=bdt)
 
             def step(k, vmask):
                 val = _fwd_dispatch(
                     operators, lambda i: buf_ref[i, :], instr_ref[t, k],
-                    y_row.dtype)
-                buf_ref[BASE + k, :] = val
+                    bdt)
+                buf_ref[BASE + k, :] = val.astype(bdt)
                 return vmask * jnp.isfinite(val).astype(vmask.dtype)
 
             m = nstep_ref[t, 0]
@@ -438,7 +462,7 @@ def _make_program_kernel(
             vmask0 = jnp.ones((tile,), y_row.dtype)
             vmask = jax.lax.fori_loop(0, m, step, vmask0)
             valid = jnp.all((vmask > 0) | jnp.logical_not(mask_row))
-            pred = buf_ref[BASE + m - 1, :]
+            pred = buf_ref[BASE + m - 1, :].astype(y_row.dtype)
             elt = loss_fn(pred, y_row)
             elt = jnp.where(w_row > 0, elt, 0.0)
             partial = jnp.sum(elt * w_row)
@@ -492,21 +516,31 @@ def _program_launch(
     cost_scal: Optional[jax.Array],   # [1, 3] (denom, norm, parsimony)
     tree_block: int,
     tile_rows: int,
+    bf16: bool,
     interpret: bool,
 ):
     """Shared single-variant launch: the loss path (complexity=None)
-    returns (loss, valid); the cost-epilogue path also returns cost."""
+    returns (loss, valid); the cost-epilogue path also returns cost.
+
+    ``bf16`` runs the value buffer (X rows, constants, step results) in
+    bfloat16 — VMEM residency halves so row tiles grow under the same
+    budget — while the per-step arithmetic upcasts to f32 (Mosaic
+    transcendentals are f32-only anyway) and the loss/cost epilogue
+    keeps the f32 reduction spine; see fused_loss_multi's bf16 contract:
+    losses RANK reliably (f32 exponent range, ~3 significant digits) but
+    are not bit-exact — quality-gated callers only (docs/PRECISION.md)."""
     T, L = prog.code.shape
     CMAX = prog.cmax
     F, n = X.shape
     dtype = X.dtype
+    buf_dtype = jnp.bfloat16 if bf16 else dtype
     NP = 0 if params is None else params.shape[-2]
     NC = 0 if params is None else params.shape[-1]
     BASE = nfeatures + NP + CMAX
     _check_packable(operators, BASE, L)
 
     TB = tree_block
-    bytes_per = jnp.dtype(dtype).itemsize
+    bytes_per = jnp.dtype(buf_dtype).itemsize
     ZR = _zero_rows(operators)
     TILE = _pick_tile(n, tile_rows, BASE + L + ZR, bytes_per)
     T_pad = _round_up(T, TB)
@@ -521,7 +555,7 @@ def _program_launch(
     cvals = pad_t(prog.cvals).astype(dtype)
     ok = pad_t(prog.const_ok.astype(jnp.int32).reshape(-1, 1), fill=1)
 
-    Xp = jnp.pad(X, ((0, 0), (0, n_pad - n)))
+    Xp = jnp.pad(X.astype(buf_dtype), ((0, 0), (0, n_pad - n)))
     yp = jnp.pad(y.reshape(1, n), ((0, 0), (0, n_pad - n)))
     w = (jnp.ones((1, n), dtype) if weights is None
          else weights.reshape(1, n).astype(dtype))
@@ -555,7 +589,7 @@ def _program_launch(
     if NP > 0:
         in_specs.append(pl.BlockSpec((NC, TILE), lambda i, j: (0, j)))
         operands.append(
-            jnp.pad(class_oh.astype(dtype), ((0, 0), (0, n_pad - n))))
+            jnp.pad(class_oh.astype(buf_dtype), ((0, 0), (0, n_pad - n))))
     in_specs += [row_spec, row_spec, row_spec]   # y, w, mask
     operands += [yp, wp, maskp]
     if fuse_cost:
@@ -587,7 +621,7 @@ def _program_launch(
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((BASE + L + ZR, TILE), dtype)],
+        scratch_shapes=[pltpu.VMEM((BASE + L + ZR, TILE), buf_dtype)],
         interpret=interpret,
     )(*operands)
 
@@ -606,7 +640,7 @@ def _program_launch(
     jax.jit,
     static_argnames=(
         "nfeatures", "operators", "loss_fn", "tree_block", "tile_rows",
-        "interpret",
+        "bf16", "interpret",
     ),
 )
 def fused_loss_program(
@@ -622,6 +656,7 @@ def fused_loss_program(
     class_oh: Optional[jax.Array] = None,   # [NC, n] class one-hots
     tree_block: int = 16,
     tile_rows: int = 16384,
+    bf16: bool = False,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Mean elementwise loss per compiled tree program (flat [T]).
@@ -630,14 +665,14 @@ def fused_loss_program(
     program must have been compiled with the matching ``n_params``."""
     return _program_launch(
         prog, X, y, weights, nfeatures, operators, loss_fn, params,
-        class_oh, None, None, tree_block, tile_rows, interpret)
+        class_oh, None, None, tree_block, tile_rows, bf16, interpret)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "nfeatures", "operators", "loss_fn", "tree_block", "tile_rows",
-        "interpret",
+        "bf16", "interpret",
     ),
 )
 def fused_cost_program(
@@ -655,6 +690,7 @@ def fused_cost_program(
     parsimony,                  # float (or scalar array)
     tree_block: int = 16,
     tile_rows: int = 16384,
+    bf16: bool = False,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(cost, loss, valid) per compiled program, cost fused in-kernel.
@@ -678,7 +714,7 @@ def fused_cost_program(
     ]).reshape(1, 3)
     return _program_launch(
         prog, X, y, weights, nfeatures, operators, loss_fn, None, None,
-        complexity, scal, tree_block, tile_rows, interpret)
+        complexity, scal, tree_block, tile_rows, bf16, interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -1359,8 +1395,8 @@ def fused_grad_program(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "operators", "loss_fn", "tree_block", "tile_rows", "interpret",
-        "dedup",
+        "operators", "loss_fn", "tree_block", "tile_rows", "bf16",
+        "interpret", "dedup",
     ),
 )
 def fused_loss(
@@ -1375,6 +1411,7 @@ def fused_loss(
     class_idx: Optional[jax.Array] = None,  # [n] int class per row
     tree_block: int = 8,
     tile_rows: int = 16384,
+    bf16: bool = False,
     interpret: bool = False,
     dedup: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -1411,7 +1448,10 @@ def fused_loss(
             X.dtype)
     # dedup groups constants through a float32 bitcast — gate on f32 so
     # f64 runs never merge members distinct only below f32 resolution.
-    if dedup and NP == 0 and prog.cvals.dtype == jnp.float32:
+    # (bf16 keeps the dedup grouping valid — identical f32 constants stay
+    # identical after the downcast — but the dedup kernel has no bf16
+    # buffer variant, so bf16 callers take the plain program launch.)
+    if dedup and NP == 0 and prog.cvals.dtype == jnp.float32 and not bf16:
         loss, valid = fused_loss_dedup(
             prog, X, y, weights, F, operators, loss_fn,
             tree_block=tree_block, tile_rows=tile_rows, interpret=interpret,
@@ -1420,7 +1460,8 @@ def fused_loss(
         loss, valid = fused_loss_program(
             prog, X, y, weights, F, operators, loss_fn,
             params=p_flat, class_oh=class_oh,
-            tree_block=tree_block, tile_rows=tile_rows, interpret=interpret,
+            tree_block=tree_block, tile_rows=tile_rows, bf16=bf16,
+            interpret=interpret,
         )
     if NP > 0:
         # const_ok analogue for the parameter region: a non-finite bank
@@ -1437,7 +1478,8 @@ def fused_loss(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "operators", "loss_fn", "tree_block", "tile_rows", "interpret",
+        "operators", "loss_fn", "tree_block", "tile_rows", "bf16",
+        "interpret",
     ),
 )
 def fused_cost(
@@ -1454,6 +1496,7 @@ def fused_cost(
     parsimony,
     tree_block: int = 8,
     tile_rows: int = 16384,
+    bf16: bool = False,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(cost, loss, valid) per tree with the loss→cost epilogue fused
@@ -1474,7 +1517,7 @@ def fused_cost(
         prog, X, y, weights, complexity.reshape(-1), F, operators, loss_fn,
         baseline_loss=baseline_loss, use_baseline=use_baseline,
         parsimony=parsimony, tree_block=tree_block, tile_rows=tile_rows,
-        interpret=interpret,
+        bf16=bf16, interpret=interpret,
     )
     if batch_shape:
         return (cost.reshape(batch_shape), loss.reshape(batch_shape),

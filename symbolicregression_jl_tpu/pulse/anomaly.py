@@ -26,7 +26,10 @@ Watched metrics (docs/OBSERVABILITY.md has the threshold table):
 - ``invalid_fraction`` — invalid candidates / candidates from the
   device counters, when the JSONL stream already pulled them (the
   detector never adds a device transfer of its own); a NaN storm
-  spikes it. Absolute threshold.
+  spikes it. Absolute threshold. Under staged eval the structural
+  unrescored-candidate NaN floor (screen_rows - rescore_rows,
+  docs/PRECISION.md) is subtracted first — the rule watches the
+  rescored candidates, which a genuine storm still poisons.
 
 Bit-neutral by construction: reads only host-side values the loop
 already materialized, never touches state, keys, or options.
@@ -53,6 +56,13 @@ class AnomalyThresholds:
     invalid_fraction_max: float = 0.5
     cooldown: int = 8             # iterations between events per metric
     max_events: int = 32          # per-run event budget
+    # graftstage staged-eval drift rule: relative tolerance on the
+    # observed rescore fraction (rescore_rows / screen_rows from the
+    # device counters) vs the configured Options.rescore_fraction. The
+    # counters are exact static counts, so any drift beyond rounding
+    # means the compiled program and the host-side config disagree —
+    # a stale AOT executable or a mis-threaded knob.
+    rescore_drift_tol: float = 0.2
 
 
 class _Rolling:
@@ -93,10 +103,13 @@ class AnomalyDetector:
         *,
         thresholds: Optional[AnomalyThresholds] = None,
         on_anomaly: Optional[Callable[[str, int], None]] = None,
+        expected_rescore_fraction: Optional[float] = None,
     ) -> None:
         self.hub = hub
         self.t = thresholds or AnomalyThresholds()
         self.on_anomaly = on_anomaly
+        # None = staged eval off (or unknown config): drift rule dormant
+        self.expected_rescore_fraction = expected_rescore_fraction
         self.events = 0
         self._roll: Dict[str, _Rolling] = {
             "evals_per_sec": _Rolling(self.t.alpha),
@@ -181,14 +194,49 @@ class AnomalyDetector:
         self._last_traces = traces
 
         # invalid fraction from the device counters, when the stream
-        # already fetched them (ctx.counters stays empty otherwise)
+        # already fetched them (ctx.counters stays empty otherwise).
+        # Under graftstage staged eval (docs/PRECISION.md) every
+        # UNRESCORED candidate carries NaN cost by contract and the
+        # device counter counts it invalid — subtract that structural
+        # floor and measure the storm among rescored candidates, where
+        # a genuine NaN storm still lands (NaN screens rank last but
+        # top-k must still fill rescore_rows slots).
         worst = None
         for c in ctx.counters or ():
             if c and c.get("candidates"):
-                frac = c.get("invalid", 0) / c["candidates"]
+                inv = c.get("invalid", 0)
+                cand = c["candidates"]
+                unrescored = max(
+                    0, c.get("screen_rows", 0) - c.get("rescore_rows", 0))
+                if unrescored:
+                    inv = max(0, inv - unrescored)
+                    cand = max(1, cand - unrescored)
+                frac = inv / cand
                 worst = frac if worst is None else max(worst, frac)
         if worst is not None and worst > self.t.invalid_fraction_max:
             self._fire(
                 "invalid_fraction", it, value=round(worst, 6),
                 threshold=self.t.invalid_fraction_max,
             )
+
+        # graftstage rescore-fraction drift (docs/PRECISION.md): the
+        # staged screen/rescore counts are static per compiled program,
+        # so the observed ratio should match the configured fraction up
+        # to per-launch ceil rounding; past rescore_drift_tol the
+        # program serving this search was built from different knobs.
+        expect = self.expected_rescore_fraction
+        if expect:
+            worst_drift = None
+            observed = None
+            for c in ctx.counters or ():
+                if c and c.get("screen_rows"):
+                    frac = c.get("rescore_rows", 0) / c["screen_rows"]
+                    drift = abs(frac - expect) / expect
+                    if worst_drift is None or drift > worst_drift:
+                        worst_drift, observed = drift, frac
+            if worst_drift is not None and worst_drift > self.t.rescore_drift_tol:
+                self._fire(
+                    "rescore_fraction_drift", it,
+                    value=round(observed, 6), expected=round(expect, 6),
+                    threshold=self.t.rescore_drift_tol,
+                )

@@ -39,6 +39,13 @@ Counter semantics (schema `graftscope.v1`, docs/OBSERVABILITY.md):
 - ``eval_rows`` / ``eval_launches`` — rows through / launches of the
   candidate-eval kernel (per island in the cycle part; the iteration
   epilogue adds the finalize re-eval).
+- ``screen_rows`` / ``screen_launches`` / ``rescore_rows`` /
+  ``rescore_launches`` — graftstage staged-eval counters
+  (docs/PRECISION.md): candidates through / launches of the sampled
+  screening pass and the full-row rescore pass. All zero when staging
+  is off; when it is on, ``rescore_rows / screen_rows`` is the observed
+  rescore fraction (graftpulse's drift rule compares it against the
+  configured ``rescore_fraction``).
 """
 
 from __future__ import annotations
@@ -100,6 +107,10 @@ class CycleTelemetry:
     invalid: jax.Array         # [] int32
     eval_rows: jax.Array       # [] int32
     eval_launches: jax.Array   # [] int32
+    screen_rows: jax.Array     # [] int32 (staged eval only, else 0)
+    screen_launches: jax.Array   # [] int32
+    rescore_rows: jax.Array      # [] int32
+    rescore_launches: jax.Array  # [] int32
 
 
 @jax.tree_util.register_dataclass
@@ -133,6 +144,10 @@ def empty_cycle_telemetry() -> CycleTelemetry:
         invalid=z,
         eval_rows=z,
         eval_launches=z,
+        screen_rows=z,
+        screen_launches=z,
+        rescore_rows=z,
+        rescore_launches=z,
     )
 
 
@@ -167,6 +182,8 @@ def step_telemetry(
     needs_eval1: jax.Array,   # [B] bool
     needs_eval2: jax.Array,   # [B] bool
     n_eval_rows: int,         # static rows in this step's eval launch
+    n_screen_rows: int = 0,   # static candidates screened (staged eval)
+    n_rescore_rows: int = 0,  # static candidates rescored (staged eval)
 ) -> CycleTelemetry:
     """Counters for one generation step, from values the step already
     computed (no extra RNG draws, no change to the search dataflow — the
@@ -205,7 +222,11 @@ def step_telemetry(
         candidates=cands,
         invalid=inv,
         eval_rows=jnp.int32(n_eval_rows),
-        eval_launches=jnp.int32(1),
+        eval_launches=jnp.int32(2 if n_screen_rows else 1),
+        screen_rows=jnp.int32(n_screen_rows),
+        screen_launches=jnp.int32(1 if n_screen_rows else 0),
+        rescore_rows=jnp.int32(n_rescore_rows),
+        rescore_launches=jnp.int32(1 if n_rescore_rows else 0),
     )
 
 

@@ -152,6 +152,10 @@ def _counters_to_dict(telem) -> Optional[Dict[str, Any]]:
         "invalid": int(t.cycle.invalid),
         "eval_rows": int(t.cycle.eval_rows),
         "eval_launches": int(t.cycle.eval_launches),
+        "screen_rows": int(t.cycle.screen_rows),
+        "screen_launches": int(t.cycle.screen_launches),
+        "rescore_rows": int(t.cycle.rescore_rows),
+        "rescore_launches": int(t.cycle.rescore_launches),
         "dedup": {
             "rows": rows,
             "unique": unique,
@@ -170,8 +174,11 @@ def _merge_counts(acc: Dict[str, Any], new: Dict[str, Any]) -> Dict[str, Any]:
             k: acc[key].get(k, 0) + new[key].get(k, 0)
             for k in set(acc[key]) | set(new[key])
         }
-    for key in ("candidates", "invalid", "eval_rows", "eval_launches"):
-        out[key] = acc[key] + new[key]
+    for key in ("candidates", "invalid", "eval_rows", "eval_launches",
+                "screen_rows", "screen_launches", "rescore_rows",
+                "rescore_launches"):
+        # .get: pre-graftstage snapshots carry no screen/rescore keys
+        out[key] = acc.get(key, 0) + new.get(key, 0)
     for key in ("loss_hist", "complexity_hist"):
         out[key] = [a + b for a, b in zip(acc[key], new[key])]
     return out

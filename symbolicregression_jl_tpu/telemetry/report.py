@@ -244,6 +244,27 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
             )
             agg["eval_rows"] = sum(c["eval_rows"] for c in counters)
             agg["eval_launches"] = sum(c["eval_launches"] for c in counters)
+            # graftstage staged-eval counters (.get: pre-graftstage
+            # streams don't carry them)
+            screen = sum(c.get("screen_rows", 0) for c in counters)
+            if screen:
+                agg["screen_rows"] = screen
+                agg["rescore_rows"] = sum(
+                    c.get("rescore_rows", 0) for c in counters)
+                agg["screen_launches"] = sum(
+                    c.get("screen_launches", 0) for c in counters)
+                agg["rescore_launches"] = sum(
+                    c.get("rescore_launches", 0) for c in counters)
+                agg["observed_rescore_fraction"] = _rate(
+                    agg["rescore_rows"], screen)
+                # the raw invalid_fraction includes the structural
+                # unrescored-NaN floor (docs/PRECISION.md); this is the
+                # storm-relevant fraction among rescored candidates
+                unrescored = screen - agg["rescore_rows"]
+                agg["rescored_invalid_fraction"] = _rate(
+                    max(0, sum(c["invalid"] for c in counters) - unrescored),
+                    max(1, cands - unrescored),
+                )
             dedup_rows = sum(c["dedup"]["rows"] for c in counters)
             agg["dedup_hit_rate"] = _rate(
                 sum(c["dedup"]["hits"] for c in counters), dedup_rows
@@ -466,6 +487,16 @@ def format_report(summary: Dict[str, Any]) -> str:
                 f"{_fmt_num(out['eval_launches'])} launches  |  "
                 f"dedup hit-rate {_fmt_pct(out['dedup_hit_rate'])}"
             )
+            if out.get("screen_rows"):
+                lines.append(
+                    f"  staged eval: screened "
+                    f"{_fmt_num(out['screen_rows'])}, rescored "
+                    f"{_fmt_num(out['rescore_rows'])}  "
+                    f"(observed rescore fraction "
+                    f"{_fmt_pct(out['observed_rescore_fraction'])}, "
+                    f"rescored-invalid "
+                    f"{_fmt_pct(out.get('rescored_invalid_fraction'))})"
+                )
             rej = out.get("reject_reasons", {})
             if rej:
                 lines.append(

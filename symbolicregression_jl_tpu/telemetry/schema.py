@@ -153,6 +153,16 @@ _COUNTER_FIELDS: Dict[str, Any] = {
     "dedup": dict,
 }
 
+# graftstage staged-eval counters: emitted by every post-graftstage
+# stream, but optional in the schema so pre-graftstage artifacts still
+# validate. Type-checked when present.
+_OPTIONAL_COUNTER_FIELDS: Dict[str, Any] = {
+    "screen_rows": int,
+    "screen_launches": int,
+    "rescore_rows": int,
+    "rescore_launches": int,
+}
+
 
 def _type_ok(value, spec) -> bool:
     if isinstance(spec, tuple):
@@ -220,6 +230,14 @@ def validate_event(obj: Any) -> List[str]:
                 _check_fields(
                     counters, _COUNTER_FIELDS, where + ".counters", errors
                 )
+                for name, spec in _OPTIONAL_COUNTER_FIELDS.items():
+                    if name in counters and not _type_ok(
+                            counters[name], spec):
+                        errors.append(
+                            f"{where}.counters: field {name!r} has type "
+                            f"{type(counters[name]).__name__}, "
+                            f"expected {spec}"
+                        )
     if ev == "iteration" and isinstance(obj.get("recompiles"), dict):
         for k in ("traces", "backend_compiles"):
             if not isinstance(obj["recompiles"].get(k), int):

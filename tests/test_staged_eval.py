@@ -1,0 +1,415 @@
+"""graftstage tests: staged sample-then-rescore eval + bf16 row tiles.
+
+Covers the docs/PRECISION.md contract — with both modes off the engine
+is bit-identical to the pre-graftstage defaults; with staging on, only
+fully-rescored costs enter the population (unrescored candidates reject
+via NaN); sample geometry respects the shield degrade ladder's
+tile-rows step-down; and the new Options knobs reach
+``options_fingerprint`` so serve's executable cache and mesh AOT
+serialization can never cross-serve precisions.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import symbolicregression_jl_tpu as sr
+from symbolicregression_jl_tpu import make_dataset, search_key
+from symbolicregression_jl_tpu.api.checkpoint import options_fingerprint
+from symbolicregression_jl_tpu.core.losses import l2_dist_loss
+from symbolicregression_jl_tpu.evolve.engine import Engine
+from symbolicregression_jl_tpu.evolve.population import init_population
+from symbolicregression_jl_tpu.evolve.step import (
+    MIN_SAMPLE_ROWS,
+    evolve_config_from_options,
+    rescore_count,
+    resolve_sample_rows,
+)
+from symbolicregression_jl_tpu.ops.complexity import (
+    build_complexity_tables,
+    compute_complexity_batch,
+)
+from symbolicregression_jl_tpu.ops.fused_eval import (
+    fused_cost,
+    strided_sample_indices,
+)
+
+
+# ---------------------------------------------------------------------------
+# strided sampling + sample-size resolution
+# ---------------------------------------------------------------------------
+
+
+def test_strided_sample_indices_deterministic_and_bounded():
+    idx = strided_sample_indices(10_000, 1250)
+    assert idx.dtype == np.int32
+    assert idx.shape == (1250,)
+    assert idx[0] == 0 and idx[-1] < 10_000
+    assert np.all(np.diff(idx) > 0)  # strictly increasing: no dup rows
+    # replay-stable: same inputs, same rows (no RNG anywhere)
+    assert np.array_equal(idx, strided_sample_indices(10_000, 1250))
+
+
+def test_strided_sample_indices_degenerate():
+    # sample >= dataset: every row, once
+    assert np.array_equal(strided_sample_indices(7, 100), np.arange(7))
+    with pytest.raises(ValueError):
+        strided_sample_indices(100, 0)
+
+
+def _cfg(**kw):
+    opts = sr.Options(
+        binary_operators=["+", "*"], unary_operators=["cos"], maxsize=10,
+        save_to_file=False, **kw)
+    return evolve_config_from_options(opts, 2)
+
+
+def test_resolve_sample_rows_fraction_and_floor():
+    cfg = _cfg(staged_eval=True, staged_sample_fraction=0.125)
+    assert resolve_sample_rows(cfg, 10_000) == 1250
+    # floor: tiny datasets screen at least MIN_SAMPLE_ROWS rows
+    assert resolve_sample_rows(cfg, 100) == min(100, MIN_SAMPLE_ROWS)
+    # never more rows than the dataset has
+    assert resolve_sample_rows(cfg, 32) == 32
+
+
+def test_resolve_sample_rows_explicit_override():
+    cfg = _cfg(staged_eval=True, staged_sample_rows=777)
+    assert resolve_sample_rows(cfg, 10_000) == 777
+
+
+def test_resolve_sample_rows_capped_by_tile_rows():
+    cfg = _cfg(staged_eval=True, staged_sample_rows=8192,
+               eval_tile_rows=2048)
+    assert resolve_sample_rows(cfg, 100_000) == 2048
+
+
+def test_rescore_count():
+    cfg = _cfg(staged_eval=True, rescore_fraction=0.25)
+    assert rescore_count(cfg, 100) == 25
+    assert rescore_count(cfg, 101) == 26   # ceil
+    assert rescore_count(cfg, 1) == 1      # at least one rescore
+    cfg1 = _cfg(staged_eval=True, rescore_fraction=1.0)
+    assert rescore_count(cfg1, 64) == 64
+
+
+def test_degrade_tile_rows_keeps_sample_inside_tile():
+    """The graftshield OOM step-down halves eval_tile_rows; the staged
+    screening sample must follow it down (sample_rows <= tile_rows at
+    every rung), or the screen launch would span multiple row tiles of
+    a geometry the shield just shrank to relieve memory pressure."""
+    opts = sr.Options(
+        binary_operators=["+", "*"], unary_operators=["cos"], maxsize=8,
+        populations=2, population_size=8, tournament_selection_n=4,
+        ncycles_per_iteration=2, save_to_file=False,
+        staged_eval=True, staged_sample_rows=4096,
+    )
+    eng = Engine(opts, 2)
+    n_rows = 1_000_000  # big enough that only the tile cap binds
+    assert resolve_sample_rows(eng.cfg, n_rows) == 4096
+    while True:
+        new = eng.degrade_eval_tile_rows(floor=512)
+        if new is None:
+            break
+        assert resolve_sample_rows(eng.cfg, n_rows) <= new
+    assert eng.cfg.eval_tile_rows == 512
+    assert resolve_sample_rows(eng.cfg, n_rows) == 512
+
+
+# ---------------------------------------------------------------------------
+# options_fingerprint x graftstage knobs
+# ---------------------------------------------------------------------------
+
+
+def _fp(**kw):
+    return options_fingerprint(sr.Options(
+        binary_operators=["+", "*"], unary_operators=["cos"],
+        maxsize=10, save_to_file=False, **kw))
+
+
+def test_fingerprint_distinguishes_precision_and_staging():
+    """serve's ExecutableCache and mesh/aot.py key executables by
+    options_fingerprint — two configs differing only in eval precision
+    or staging knobs must never share a compiled program."""
+    base = _fp()
+    assert base is not None
+    fps = {
+        "base": base,
+        "bf16": _fp(eval_precision="bf16"),
+        "staged": _fp(staged_eval=True),
+        "staged_rows": _fp(staged_eval=True, staged_sample_rows=512),
+        "staged_frac": _fp(staged_eval=True, staged_sample_fraction=0.5),
+        "rescore": _fp(staged_eval=True, rescore_fraction=0.5),
+    }
+    assert len(set(fps.values())) == len(fps), fps
+    # explicit defaults == implicit defaults (no spurious cache split)
+    assert _fp(eval_precision="f32", staged_eval=False) == base
+
+
+def test_options_validate_graftstage_knobs():
+    with pytest.raises(ValueError):
+        sr.Options(binary_operators=["+"], eval_precision="f16",
+                   save_to_file=False)
+    with pytest.raises(ValueError):
+        sr.Options(binary_operators=["+"], rescore_fraction=0.0,
+                   save_to_file=False)
+    with pytest.raises(ValueError):
+        sr.Options(binary_operators=["+"], staged_sample_fraction=1.5,
+                   save_to_file=False)
+    with pytest.raises(ValueError):
+        sr.Options(binary_operators=["+"], staged_sample_rows=-4,
+                   save_to_file=False)
+
+
+# ---------------------------------------------------------------------------
+# bf16 kernel path: rank-reliable, f32 untouched
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def kernel_setup():
+    opts = sr.Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "abs", "exp"],
+        maxsize=20, save_to_file=False)
+    cfg = evolve_config_from_options(opts, 3)
+    tables = build_complexity_tables(opts, 3)
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.uniform(-3, 3, (3, 257)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=257).astype(np.float32))
+    trees = init_population(jax.random.PRNGKey(3), 64, cfg.mctx,
+                            jnp.float32)
+    cx = compute_complexity_batch(trees, tables)
+    kw = dict(baseline_loss=jnp.float32(1.7),
+              use_baseline=jnp.bool_(True), parsimony=0.0032)
+    return cfg, trees, cx, X, y, kw
+
+
+def test_fused_cost_bf16_rank_reliable(kernel_setup):
+    """bf16 row tiles keep an f32 reduction spine: losses agree with
+    f32 to bf16 rounding, and the cost RANKING — the only thing the
+    staged screen consumes — matches on the candidates that matter."""
+    cfg, trees, cx, X, y, kw = kernel_setup
+    c32, l32, v32 = fused_cost(
+        trees, X, y, None, cx, cfg.operators, l2_dist_loss,
+        interpret=True, **kw)
+    c16, l16, v16 = fused_cost(
+        trees, X, y, None, cx, cfg.operators, l2_dist_loss,
+        interpret=True, bf16=True, **kw)
+    assert l16.dtype == jnp.float32 and c16.dtype == jnp.float32
+    a, b = np.asarray(c32), np.asarray(c16)
+    ok = np.isfinite(a) & np.isfinite(b)
+    assert ok.sum() >= 0.9 * len(a)  # finiteness verdicts mostly agree
+    rel = np.abs(b[ok] - a[ok]) / (np.abs(a[ok]) + 1e-6)
+    assert np.median(rel) < 0.02
+    # top-quartile overlap: the screen's promotion set is stable
+    k = max(1, int(ok.sum()) // 4)
+    top32 = set(np.argsort(np.where(ok, a, np.inf))[:k])
+    top16 = set(np.argsort(np.where(ok, b, np.inf))[:k])
+    assert len(top32 & top16) >= 0.75 * k
+
+
+def test_fused_cost_f32_default_unchanged_by_bf16_kwarg(kernel_setup):
+    """bf16=False is the default and must be a no-op — same bits."""
+    cfg, trees, cx, X, y, kw = kernel_setup
+    c_a, l_a, _ = fused_cost(
+        trees, X, y, None, cx, cfg.operators, l2_dist_loss,
+        interpret=True, **kw)
+    c_b, l_b, _ = fused_cost(
+        trees, X, y, None, cx, cfg.operators, l2_dist_loss,
+        interpret=True, bf16=False, **kw)
+    assert np.array_equal(np.asarray(c_a), np.asarray(c_b))
+    assert np.array_equal(np.asarray(l_a), np.asarray(l_b))
+
+
+# ---------------------------------------------------------------------------
+# engine level: defaults-off bit-identity + staged semantics
+# ---------------------------------------------------------------------------
+# slow tier: each _run_engine traces+compiles a full turbo engine
+# (~1-2 min each on the 1-core CI box); the fast loop keeps the kernel
+# and unit layers above, and CI's mesh-staged dryrun leg + the
+# graftbench staged cells drive the engine path end-to-end.
+
+
+def _run_engine(**kw):
+    opts = sr.Options(
+        binary_operators=["+", "*"], unary_operators=["cos"], maxsize=10,
+        populations=2, population_size=12, tournament_selection_n=4,
+        ncycles_per_iteration=3, save_to_file=False, turbo=True,
+        telemetry=True, **kw)
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (300, 2)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 1.0).astype(np.float32)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(opts.elementwise_loss)
+    eng = Engine(opts, ds.nfeatures)
+    state = eng.init_state(search_key(0), ds.data, 2)
+    for _ in range(2):
+        state = eng.run_iteration(state, ds.data, jnp.int32(opts.maxsize))
+    return eng, state
+
+
+@pytest.fixture(scope="module")
+def default_engine_run():
+    return _run_engine()
+
+
+@pytest.fixture(scope="module")
+def staged_engine_run():
+    return _run_engine(staged_eval=True, staged_sample_fraction=0.25,
+                       rescore_fraction=0.3)
+
+
+@pytest.mark.slow
+def test_engine_defaults_off_bit_identical(default_engine_run):
+    """The graftstage A/B pin: Options that never mention the new knobs
+    and Options passing their explicit defaults trace the SAME program
+    and produce bit-identical search trajectories."""
+    eng_a, a = default_engine_run
+    assert not eng_a.cfg.staged_eval and not eng_a.cfg.eval_bf16
+    eng_b, b = _run_engine(eval_precision="f32", staged_eval=False)
+    for name in ("cost", "loss", "complexity", "birth", "ref"):
+        assert np.array_equal(
+            np.asarray(getattr(a.pops, name)),
+            np.asarray(getattr(b.pops, name)), equal_nan=True), name
+    for la, lb in zip(jax.tree.leaves(a.pops.trees),
+                      jax.tree.leaves(b.pops.trees)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb),
+                              equal_nan=True)
+    assert np.array_equal(np.asarray(a.hof.cost), np.asarray(b.hof.cost),
+                          equal_nan=True)
+
+
+@pytest.mark.slow
+def test_engine_staged_population_costs_are_full_data(staged_engine_run):
+    """Staged acceptance consumes only fully-rescored costs: every
+    population cost must equal a from-scratch FULL-dataset re-eval of
+    that member (no sample-estimated cost ever survives into state)."""
+    eng, s = staged_engine_run
+    assert eng.cfg.staged_eval
+    cost = np.asarray(s.pops.cost)
+    assert np.all(np.isfinite(cost))
+    # recompute costs of the final population on the full dataset via
+    # the engine's own (unstaged) finalize evaluator
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (300, 2)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 1.0).astype(np.float32)
+    ds = make_dataset(X, y)
+    ds.update_baseline_loss(eng.options.elementwise_loss)
+    flat = jax.tree.map(
+        lambda x: x.reshape((-1,) + x.shape[2:]), s.pops.trees)
+    c_ref, _, _ = eng._eval_cost(flat, ds.data)
+    assert np.allclose(cost.reshape(-1), np.asarray(c_ref), rtol=1e-5,
+                       atol=1e-6)
+
+
+@pytest.mark.slow
+def test_engine_staged_telemetry_counters(staged_engine_run):
+    """screen/rescore counters expose the mechanism: every candidate is
+    screened, only the configured fraction is rescored, and the
+    full-eval row volume drops accordingly."""
+    eng, s = staged_engine_run
+    t = s.telem.cycle
+    screen, rescore = int(t.screen_rows), int(t.rescore_rows)
+    assert screen > 0 and 0 < rescore < screen
+    # per-launch ceil(N * fraction): observed fraction is within one
+    # candidate per launch of the configured one
+    launches = int(t.screen_launches)
+    assert launches == int(t.rescore_launches) > 0
+    lo = eng.cfg.rescore_fraction
+    hi = eng.cfg.rescore_fraction + launches / screen
+    assert lo <= rescore / screen <= hi + 1e-9
+    # the staged path adds the screen launch on top of the rescore one
+    assert int(t.eval_launches) >= 2 * launches
+
+
+@pytest.mark.slow
+def test_unstaged_telemetry_counters_zero(default_engine_run):
+    _, s = default_engine_run
+    t = s.telem.cycle
+    assert int(t.screen_rows) == 0 and int(t.rescore_rows) == 0
+    assert int(t.screen_launches) == 0 and int(t.rescore_launches) == 0
+
+
+# ---------------------------------------------------------------------------
+# pulse: rescore_fraction drift rule
+# ---------------------------------------------------------------------------
+
+
+class _Hub:
+    def __init__(self):
+        self.anomalies = []
+
+    def anomaly(self, metric, *, iteration, **detail):
+        self.anomalies.append((metric, iteration, detail))
+
+    def compile_snapshot(self):
+        return {"traces": 0}
+
+
+class _Ctx:
+    def __init__(self, iteration, counters):
+        self.iteration = iteration
+        self.num_evals = 100.0 * iteration
+        self.elapsed = float(iteration)
+        self.host_fraction = 0.1
+        self.counters = counters
+
+
+def test_rescore_drift_rule_fires_and_stays_quiet():
+    from symbolicregression_jl_tpu.pulse.anomaly import AnomalyDetector
+
+    hub = _Hub()
+    det = AnomalyDetector(hub, expected_rescore_fraction=0.25)
+    # observed fraction matches the config: quiet
+    det.on_iteration(_Ctx(1, ({"screen_rows": 400, "rescore_rows": 100},)))
+    assert hub.anomalies == []
+    # a program built from different knobs serves this search: fire
+    det.on_iteration(_Ctx(2, ({"screen_rows": 400, "rescore_rows": 300},)))
+    assert [(m, i) for m, i, _ in hub.anomalies] == [
+        ("rescore_fraction_drift", 2)]
+    detail = hub.anomalies[0][2]
+    assert detail["value"] == 0.75 and detail["expected"] == 0.25
+
+
+def test_rescore_drift_rule_dormant_without_config():
+    from symbolicregression_jl_tpu.pulse.anomaly import AnomalyDetector
+
+    hub = _Hub()
+    det = AnomalyDetector(hub)  # staging off: no expected fraction
+    det.on_iteration(_Ctx(1, ({"screen_rows": 400, "rescore_rows": 300},)))
+    assert hub.anomalies == []
+
+
+def test_invalid_fraction_rule_ignores_unrescored_nan_floor():
+    """Staged runs count every unrescored candidate invalid (NaN cost by
+    contract) — the structural floor must not read as a NaN storm."""
+    from symbolicregression_jl_tpu.pulse.anomaly import AnomalyDetector
+
+    hub = _Hub()
+    det = AnomalyDetector(hub, expected_rescore_fraction=0.25)
+    # 400 screened, 100 rescored -> 300 invalid are the structural
+    # floor; 10/100 rescored invalid is healthy. Raw 310/400 = 0.775
+    # would breach the 0.5 threshold; the adjusted rule stays quiet.
+    det.on_iteration(_Ctx(1, ({
+        "candidates": 400, "invalid": 310,
+        "screen_rows": 400, "rescore_rows": 100},)))
+    assert hub.anomalies == []
+    # A genuine storm poisons the rescored candidates too: 95/100.
+    det.on_iteration(_Ctx(2, ({
+        "candidates": 400, "invalid": 395,
+        "screen_rows": 400, "rescore_rows": 100},)))
+    assert ("invalid_fraction", 2) in [
+        (m, i) for m, i, _ in hub.anomalies]
+
+
+def test_invalid_fraction_rule_unchanged_when_unstaged():
+    from symbolicregression_jl_tpu.pulse.anomaly import AnomalyDetector
+
+    hub = _Hub()
+    det = AnomalyDetector(hub)
+    det.on_iteration(_Ctx(1, ({"candidates": 100, "invalid": 80},)))
+    assert [(m, i) for m, i, _ in hub.anomalies] == [
+        ("invalid_fraction", 1)]

@@ -42,6 +42,9 @@ echo "== graftmesh: mesh dryrun fast tier (docs/SCALING.md) =="
 JAX_PLATFORMS=cpu python -m symbolicregression_jl_tpu.mesh.dryrun \
     --devices 8 --fast --out "${TMPDIR:-/tmp}/graftmesh/dryrun.json"
 
+# The gate's default matrix includes the graftstage cells
+# (plain-staged / plain-bf16 / plain-staged-bf16, docs/PRECISION.md) —
+# staged + bf16 quality regressions beyond band fail right here.
 echo "== graftbench: benchmark-matrix gate + serve load smoke (docs/BENCHMARKING.md) =="
 JAX_PLATFORMS=cpu python -m symbolicregression_jl_tpu.bench gate \
     --baseline benchmarks/baseline.json \
