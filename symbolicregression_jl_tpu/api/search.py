@@ -143,6 +143,32 @@ class RuntimeOptions:
     # the on/off A/B in tests/test_ledger.py.
     trace: Optional[Any] = None  # ledger.context.TraceContext
     ledger: bool = True
+    # graftgauge (docs/OBSERVABILITY.md, "Capacity & memory"): device
+    # capacity observability. ``gauge`` samples live-array bytes (and
+    # allocator stats where the backend exposes memory_stats) every
+    # iteration, feeds the pulse leak tripwire, records dispatch-latency
+    # histograms, and emits ``gauge`` events — all host-side and
+    # bit-neutral (on/off A/B pinned in tests/test_gauge.py). The
+    # memory sampler only arms when something consumes it — an open
+    # telemetry stream or the proactive degrader — because the
+    # live-array walk is O(arrays alive in the process); the latency
+    # histogram (two perf_counter calls per launch) is always on.
+    gauge: bool = True
+    # Opt-in footprint probe: AOT-compiles the iteration program once
+    # per engine purely to harvest its memory/cost analysis into the
+    # footprint ledger (an extra XLA compile — off by default; mesh AOT
+    # compiles self-record without this knob).
+    gauge_footprint: bool = False
+    # Proactive degrade (shield ladder, docs/ROBUSTNESS.md): when set,
+    # a device-memory watermark crossing this fraction of the limit
+    # steps eval_tile_rows down BEFORE any OOM fires. None disables —
+    # the step-down changes results, so it is opt-in, unlike the rest
+    # of gauge.
+    gauge_headroom_fraction: Optional[float] = None
+    # Byte limit the headroom fraction applies to; None uses the
+    # backend's memory_stats bytes_limit (so on CPU — no memory_stats —
+    # the proactive ladder stays dormant unless a limit is given).
+    gauge_limit_bytes: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -995,14 +1021,17 @@ def equation_search(
             if ropt.pulse_trace_on:
                 pulse_cap.arm("option", 0)
             pulse_sig = SignalArm().install()
-        hub.add_sink(AnomalyDetector(
+        pulse_det = AnomalyDetector(
             hub,
             on_anomaly=(pulse_cap.arm if pulse_cap is not None else None),
             expected_rescore_fraction=(
                 float(getattr(options, "rescore_fraction", 0.0))
                 if getattr(options, "staged_eval", False) else None
             ),
-        ))
+        )
+        hub.add_sink(pulse_det)
+    else:
+        pulse_det = None
 
     # ---- graftledger cost account (ledger/ledger.py) ----
     # One account segment per search attempt, appended to
@@ -1022,6 +1051,80 @@ def equation_search(
         )
         hub.add_sink(ledger_sink)
         set_span_observer(ledger_sink.note_phase)
+
+    # ---- graftgauge capacity observability (gauge/, docs/OBSERVABILITY.md
+    # "Capacity & memory") ----
+    # Memory sampler: per-iteration live-array bytes + backend-guarded
+    # allocator stats, watermarks, the pulse leak tripwire, and the
+    # flight-recorder's deterministic memory snapshots. Dispatch-latency
+    # histogram: host-side timing around the iteration launch. Proactive
+    # degrader (opt-in via gauge_headroom_fraction): steps
+    # eval_tile_rows down when the watermark crosses the headroom line
+    # — before the OOM, not after it.
+    from ..gauge import DispatchLatency, MemorySampler, ProactiveDegrader
+    from ..gauge import global_latency as _gauge_global_latency
+
+    gauge_sampler = gauge_lat = None
+    # The sampler's jax.live_arrays() walk is O(total live arrays in
+    # the process) — cheap in a serving or bench process, but a
+    # long-lived array-heavy host (one process running many searches
+    # back to back with nothing consuming the samples) would pay it
+    # every iteration for nothing. So the sampler only arms when
+    # something reads it: an open telemetry stream (hub.path) or the
+    # proactive headroom degrader. The dispatch-latency histogram is
+    # two perf_counter calls per launch and stays on whenever gauge is.
+    gauge_wanted = (hub.path is not None
+                    or ropt.gauge_headroom_fraction is not None)
+    if ropt.gauge and is_rank0:
+        gauge_degrader = None
+        if ropt.gauge_headroom_fraction is not None:
+            def _degrade_all_engines():
+                new_rows = None
+                for _e in engines:
+                    r = _e.degrade_eval_tile_rows()
+                    if r is not None:
+                        new_rows = r
+                return new_rows
+
+            gauge_degrader = ProactiveDegrader(
+                _degrade_all_engines,
+                headroom_fraction=ropt.gauge_headroom_fraction,
+                limit_bytes=ropt.gauge_limit_bytes,
+                hub=hub,
+            )
+        if gauge_wanted:
+            gauge_sampler = MemorySampler(
+                hub, detector=pulse_det, recorder=pulse_rec,
+                degrader=gauge_degrader,
+            )
+            hub.add_sink(gauge_sampler)
+        gauge_lat = DispatchLatency()
+        if ropt.gauge_footprint:
+            # opt-in: AOT-compile each engine's iteration program once
+            # purely to harvest its memory/cost analysis (an extra XLA
+            # compile per engine; geometries the ledger already knows
+            # are skipped inside the probe)
+            from ..gauge import probe_engine_iteration
+
+            for _j, (_eng, _st, _dt) in enumerate(
+                    zip(engines, states, datas)):
+                entry = probe_engine_iteration(_eng, _st, _dt)
+                if entry is not None:
+                    hub.gauge("footprint", iteration=0,
+                              output=_j + 1, **entry)
+        if gauge_sampler is not None:
+            if ledger_sink is not None:
+                # one thread-local span-observer slot: chain the
+                # ledger's phase accounting with the sampler's
+                # per-phase watermarks
+                def _observe_span(name, seconds,
+                                  _ledger=ledger_sink, _smp=gauge_sampler):
+                    _ledger.note_phase(name, seconds)
+                    _smp.note_phase(name, seconds)
+
+                set_span_observer(_observe_span)
+            else:
+                set_span_observer(gauge_sampler.note_phase)
 
     # ---- graftshield supervision (shield/ package, docs/ROBUSTNESS.md) --
     # Preemption guard: SIGTERM/SIGINT set a flag the budget poll reads;
@@ -1270,11 +1373,21 @@ def equation_search(
                                              else None),
                                 should_stop=_budget_hit,
                             )
+                    # graftgauge dispatch latency: the launch call
+                    # (enqueue, not device execution — the blocking
+                    # sync is below). perf_counter around a call the
+                    # loop makes anyway; bit-neutral.
+                    lat_t0 = time.perf_counter() if gauge_lat is not None \
+                        else None
                     if runner is not None:
                         out = runner.run(one, iteration=it + 1,
                                          engine=engine, output=j + 1)
                     else:
                         out = one()
+                    if lat_t0 is not None:
+                        lat_dt = time.perf_counter() - lat_t0
+                        gauge_lat.observe(lat_dt)
+                        _gauge_global_latency().observe(lat_dt)
                     if engine.cfg.record_events:
                         states[j], iter_events[j] = out
                     else:
@@ -1445,6 +1558,15 @@ def equation_search(
                 "emergency_checkpoint", iteration=it,
                 path=ckpt.base, iterations_done=it,
             )
+        # graftgauge end-of-run records, while the stream is still open
+        # (hub.finish writes run_end; sink on_end output would land
+        # after it, so these are emitted explicitly here): the memory
+        # watermark summary and the dispatch-latency histogram.
+        if gauge_sampler is not None:
+            gauge_sampler.emit_final(iteration=int(it))
+        if gauge_lat is not None and gauge_lat.count:
+            hub.gauge("dispatch_latency", iteration=int(it),
+                      **gauge_lat.to_detail())
         # Flush any partial telemetry interval, emit run_end, close sinks
         # (ProgressBar close, Recorder final-state + write).
         hub.finish(
@@ -1477,10 +1599,11 @@ def equation_search(
         guard.uninstall()
         if watchdog is not None:
             watchdog.stop()
-        if ledger_sink is not None:
+        if ledger_sink is not None or gauge_sampler is not None:
             # clear this thread's span observer — a serve worker thread
             # runs many searches back to back, and the next one must
-            # not bill its phases to this request's ledger
+            # not bill its phases to this request's ledger (or this
+            # run's gauge watermarks)
             set_span_observer(None)
 
     if ropt.verbosity >= 1:

@@ -11,7 +11,11 @@ matrix. The parent (bench/matrix.py) sets the env and parses the
 
 Metrics come from the cell's graftscope telemetry JSONL via
 bench/extract.py — not from ad-hoc timers — so the gate measures
-exactly what production observability reports.
+exactly what production observability reports. That automatically
+includes the graftgauge ride-along metrics ("peak_live_bytes",
+"anomalies"): each cell records its memory watermark, and `bench
+trend` surfaces footprint creep across rounds without the gate diffing
+platform-dependent byte counts.
 """
 
 from __future__ import annotations

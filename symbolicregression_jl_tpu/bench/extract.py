@@ -17,6 +17,10 @@ __all__ = ["extract_metrics", "GATE_METRIC_KEYS"]
 
 # The subset of metrics_view keys the regression gate diffs; the rest
 # ride along in result files as context (docs/BENCHMARKING.md).
+# Ride-along (NOT gated) examples: "anomalies" (graftpulse detector
+# events) and "peak_live_bytes" (graftgauge memory watermark — `bench
+# trend` displays the worst cell, but absolute byte counts are too
+# platform-dependent to diff against a committed baseline).
 GATE_METRIC_KEYS = (
     "evals_per_sec", "best_loss", "pareto_volume", "host_fraction",
     "recompiles",

@@ -75,6 +75,10 @@ GATED_METRICS: Dict[str, Band] = {
     "recompiles": Band(direction="higher", kind="throughput",
                        rel=0.25, abs=8),
 }
+# Deliberately NOT gated: "peak_live_bytes" (graftgauge) — live-array
+# byte counts vary with jax version, platform allocator, and process
+# history, so diffing them against a committed baseline would flake;
+# `bench trend` displays the trajectory instead.
 
 # CPU wall-clock on shared CI cores is noisy; throughput bands widen by
 # this factor when REPORTING on a CPU result (quality bands never

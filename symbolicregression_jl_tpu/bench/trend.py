@@ -107,12 +107,19 @@ def _gate_row(path: str) -> Dict[str, Any]:
     # metrics_view's "anomalies" key (older artifacts predate it — 0)
     anomalies = sum(
         int(c["metrics"].get("anomalies") or 0) for c in cells.values())
+    # graftgauge: peak live-array bytes per cell rides metrics_view's
+    # "peak_live_bytes" (None in pre-gauge artifacts); the trend shows
+    # the worst cell — a memory-footprint creep across rounds is a
+    # regression signal even while throughput holds
+    peaks = [c["metrics"].get("peak_live_bytes") for c in cells.values()]
+    peaks = [int(v) for v in peaks if isinstance(v, (int, float))]
     row.update(
         matrix=rec.get("matrix"),
         platform=rec.get("platform"),
         cells=len(cells),
         failed_cells=sorted(failures),
         anomalies=anomalies,
+        peak_live_bytes=(max(peaks) if peaks else None),
         # red = cells crashed OR the embedded gate verdict failed OR an
         # otherwise-green run carried anomaly events — "fast but the
         # detector fired" is a regression signal, not a green row
@@ -255,13 +262,15 @@ def format_trend(trend: Dict[str, Any]) -> str:
         for r in trend["gates"]:
             mark = (f"RED ({r.get('note')})" if r.get("red")
                     else "green")
+            peak = r.get("peak_live_bytes")
             lines.append(
                 f"  {r['file']:<28} {r.get('matrix') or '?'}/"
                 f"{r.get('platform') or '?'}  "
                 f"cells={r.get('cells', '-')}  "
                 f"mean evals/s {_fmt(r.get('mean_evals_per_sec'))}  "
                 f"anomalies={r.get('anomalies', '-')}  "
-                f"[{mark}]")
+                + (f"peak live {peak:,} B  " if peak else "")
+                + f"[{mark}]")
     if trend.get("mesh_scaling"):
         lines.append("measured mesh scaling (profiling/mesh_scaling.py):")
         for r in trend["mesh_scaling"]:

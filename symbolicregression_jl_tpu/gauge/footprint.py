@@ -1,0 +1,269 @@
+"""Compiled-executable footprint ledger (graftgauge, part a).
+
+``mesh/aot.py`` has computed ``memory_analysis()`` on every compiled
+executable since PR 8 and nobody read it; the serve layer admits
+requests on queue depth alone; ROADMAP item 1 wants N tenants packed
+into one device program. This module is the missing bookkeeping: every
+place the stack produces a ``jax.stages.Compiled`` — mesh AOT
+executables, the opt-in fused-eval probe, a loaded AOT replica's
+stamped envelope — summarizes the backend's static analysis into a
+plain dict and records it in a process-wide ledger keyed by the
+canonical ``options_fingerprint`` plus the launch geometry.
+
+Consumers:
+
+- the serve :class:`~..serve.admission.AdmissionController` asks the
+  :class:`~.capacity.HeadroomModel` "does a request of this shape
+  fit?", which answers from this ledger's history;
+- the serve ``ExecutableCache`` stamps known footprints onto its
+  cache_hit/cache_miss telemetry details;
+- ``/metrics`` renders one ``footprint_bytes`` gauge per ledger entry
+  (serve/metrics.py ``render_gauge_metrics``);
+- ``equation_search`` emits each new entry as a ``gauge`` event
+  (kind ``footprint``) into the graftscope stream.
+
+Everything here is host-side bookkeeping over analyses XLA already
+performed at compile time — no device work, no extra transfers, and
+(like pulse/ledger) bit-neutral to the search by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "FootprintLedger",
+    "geometry_key",
+    "global_ledger",
+    "probe_engine_iteration",
+    "summarize_compiled",
+]
+
+# memory_analysis() attributes worth keeping, in stable order. Backends
+# differ in which they expose; absent ones are simply omitted.
+_MEMORY_FIELDS = (
+    "temp_size_in_bytes",
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def geometry_key(*, rows: int, nfeatures: int, nout: int = 1) -> str:
+    """Canonical geometry label: the axes that change a program's
+    footprint (dataset rows, features, outputs). Matches the admission
+    shape-bucket axes so ledger history answers bucket queries."""
+    return f"r{int(rows)}xf{int(nfeatures)}xo{int(nout)}"
+
+
+def _analysis_dict(obj) -> Optional[Dict[str, Any]]:
+    """cost_analysis() returns a dict on current jax, a 1-list of dicts
+    on some older versions, or raises on backends without HLO cost
+    modeling — normalize all of that to a flat dict or None."""
+    if obj is None:
+        return None
+    if isinstance(obj, (list, tuple)):
+        obj = obj[0] if obj else None
+    return dict(obj) if isinstance(obj, dict) else None
+
+
+def summarize_compiled(compiled) -> Optional[Dict[str, Any]]:
+    """Flatten one ``jax.stages.Compiled``'s static analyses into a
+    JSON-able summary dict, or None when the backend exposes neither
+    analysis (both are optional in the jax API contract)."""
+    out: Dict[str, Any] = {}
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 - backend-optional introspection
+        mem = None
+    if mem is not None:
+        for field in _MEMORY_FIELDS:
+            v = getattr(mem, field, None)
+            if v is not None:
+                try:
+                    out[field] = int(v)
+                except (TypeError, ValueError):
+                    pass
+    try:
+        cost = _analysis_dict(compiled.cost_analysis())
+    except Exception:  # noqa: BLE001 - backend-optional introspection
+        cost = None
+    if cost is not None:
+        flops = cost.get("flops")
+        if flops is not None:
+            try:
+                out["flops"] = float(flops)
+            except (TypeError, ValueError):
+                pass
+        ba = cost.get("bytes accessed")
+        if ba is not None:
+            try:
+                out["bytes_accessed"] = float(ba)
+            except (TypeError, ValueError):
+                pass
+    if not out:
+        return None
+    out["total_bytes"] = sum(
+        int(out.get(f, 0)) for f in _MEMORY_FIELDS)
+    return out
+
+
+class FootprintLedger:
+    """Thread-safe (fingerprint, geometry) -> footprint-summary table.
+
+    One entry per distinct compiled program the process has seen;
+    re-recording an existing key refreshes the summary and bumps its
+    compile count (the geometry was recompiled — e.g. after a shield
+    degrade rebuilt the jits at a smaller launch shape).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str], Dict[str, Any]] = {}
+
+    def record(self, fingerprint: Optional[str], geometry: str,
+               summary: Optional[Dict[str, Any]], *,
+               source: str = "unknown",
+               rows: Optional[int] = None,
+               nfeatures: Optional[int] = None,
+               nout: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Record one compiled program's footprint; returns the stored
+        entry (None when there was nothing to store)."""
+        if not summary:
+            return None
+        key = (fingerprint or "", str(geometry))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = {
+                    "fingerprint": fingerprint,
+                    "geometry": str(geometry),
+                    "source": str(source),
+                    "compiles": 0,
+                    "rows": rows,
+                    "nfeatures": nfeatures,
+                    "nout": nout,
+                }
+                self._entries[key] = entry
+            entry["compiles"] += 1
+            entry["summary"] = dict(summary)
+            return dict(entry)
+
+    def known(self, fingerprint: Optional[str], geometry: str) -> bool:
+        with self._lock:
+            return (fingerprint or "", str(geometry)) in self._entries
+
+    def lookup(self, fingerprint: Optional[str],
+               geometry: Optional[str] = None
+               ) -> Optional[Dict[str, Any]]:
+        """The entry for an exact (fingerprint, geometry) key, or —
+        with geometry None — the largest-footprint entry recorded for
+        the fingerprint at any geometry (the conservative answer for
+        "what does this config cost")."""
+        with self._lock:
+            if geometry is not None:
+                e = self._entries.get((fingerprint or "", str(geometry)))
+                return dict(e) if e is not None else None
+            best = None
+            for (fp, _), e in self._entries.items():
+                if fp != (fingerprint or ""):
+                    continue
+                if best is None or (
+                        e["summary"].get("total_bytes", 0)
+                        > best["summary"].get("total_bytes", 0)):
+                    best = e
+            return dict(best) if best is not None else None
+
+    def predict_bytes(self, *, rows: Optional[int] = None,
+                      nfeatures: Optional[int] = None,
+                      fingerprint: Optional[str] = None
+                      ) -> Optional[int]:
+        """Footprint estimate for a prospective program: the largest
+        ``total_bytes`` among entries matching the given axes (None
+        axes match everything; rows matches entries at or below the
+        requested count — a bigger dataset can only cost more, so the
+        estimate is a floor, reported as such by the headroom model)."""
+        with self._lock:
+            best: Optional[int] = None
+            for e in self._entries.values():
+                if fingerprint is not None and \
+                        e.get("fingerprint") != fingerprint:
+                    continue
+                if nfeatures is not None and \
+                        e.get("nfeatures") not in (None, int(nfeatures)):
+                    continue
+                if rows is not None and e.get("rows") is not None \
+                        and int(e["rows"]) > int(rows):
+                    continue
+                total = e["summary"].get("total_bytes")
+                if total and (best is None or int(total) > best):
+                    best = int(total)
+            return best
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """Stable-ordered snapshot (the /metrics render and `telemetry
+        report` iterate this)."""
+        with self._lock:
+            return [dict(e) for _, e in sorted(self._entries.items())]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+# Process-wide ledger: compile sites (mesh/aot.py, the search-loop
+# probe) record into it from wherever compilation happens, and the
+# serve layer's /metrics + admission advisor read it without threading
+# a handle through every constructor. Append-only bookkeeping guarded
+# by its own lock; never nested with any other lock.
+_GLOBAL = FootprintLedger()
+
+
+def global_ledger() -> FootprintLedger:
+    return _GLOBAL
+
+
+def probe_engine_iteration(engine, state, data, cur_maxsize=None,
+                           *, ledger: Optional[FootprintLedger] = None,
+                           source: str = "probe"
+                           ) -> Optional[Dict[str, Any]]:
+    """AOT-compile the engine's iteration program purely to harvest its
+    footprint (the fused-eval launch path has no public handle on the
+    executables its ``jax.jit`` wrappers cache, so the probe lowers the
+    same program explicitly — an extra XLA compile, which is why the
+    search loop gates it behind ``RuntimeOptions(gauge_footprint)`` and
+    skips geometries the ledger already knows).
+
+    Returns the recorded ledger entry, or None when the probe could not
+    compile/summarize (never raises — observability must not take down
+    the search it measures).
+    """
+    led = ledger if ledger is not None else _GLOBAL
+    try:
+        from ..api.checkpoint import options_fingerprint
+        from ..mesh.aot import compile_iteration
+
+        fp = options_fingerprint(engine.options)
+        rows = int(data.y.shape[0])
+        geom = geometry_key(rows=rows, nfeatures=int(engine.nfeatures))
+        if led.known(fp, geom):
+            return led.lookup(fp, geom)
+        # compile_iteration records its own harvest into the global
+        # ledger (source "mesh_aot"); prefer that entry and only record
+        # directly when the AOT-side harvest came up empty
+        ex = compile_iteration(engine, state, data, cur_maxsize)
+        entry = led.lookup(fp, geom)
+        if entry is not None:
+            return entry
+        return led.record(
+            fp, geom, summarize_compiled(ex.compiled), source=source,
+            rows=rows, nfeatures=int(engine.nfeatures), nout=1,
+        )
+    except Exception:  # noqa: BLE001 - probe is best-effort by contract
+        return None

@@ -1,0 +1,207 @@
+"""Live memory sampling at iteration boundaries (graftgauge, part b).
+
+A telemetry-hub sink that, once per iteration boundary, accounts the
+process' live device memory two ways:
+
+- ``jax.live_arrays()`` byte totals — works on EVERY backend (it walks
+  the host-side array registry; no device call), and is the portable
+  signal the leak tripwire and the bundle snapshot use;
+- ``device.memory_stats()`` — allocator truth (bytes_in_use /
+  peak_bytes_in_use / bytes_limit) where the backend exposes it. The
+  CPU backend does NOT (returns None or raises, jax-version dependent);
+  the sampler degrades to the live-arrays path with ``stats: None``
+  rather than failing — pinned by tests/test_gauge.py.
+
+Per-iteration results feed four consumers, all host-side:
+
+1. a ``gauge`` event (kind ``memory``) into the graftscope stream;
+2. the graftpulse :class:`~..pulse.anomaly.AnomalyDetector` leak
+   tripwire (``observe_live_bytes`` — monotonic growth over K
+   iterations fires a ``live_bytes_growth`` anomaly, which also
+   triggers a flight-recorder bundle dump);
+3. the flight recorder's deterministic per-iteration view, as a
+   BASELINE-RELATIVE delta: absolute live bytes include whatever else
+   the process holds (a previous run's returned state, test fixtures),
+   so the bundle records growth since run start — the part that is
+   reproducible across identical runs — keeping the bundle
+   byte-stability contract intact;
+4. the proactive headroom degrader (capacity.py), handed the
+   watermark so it can step ``eval_tile_rows`` down BEFORE an OOM.
+
+Per-phase watermarks ride the host-span observer chain (the same
+``(name, seconds)`` callback the cost ledger uses): the peak sampled
+live bytes attributed to each named host phase's completion (the
+latest iteration sample — spans do not re-walk the registry),
+summarized into the run-end ``gauge`` event.
+
+Reads only; never touches state, keys, or options — bit-neutral, with
+the on/off HoF A/B pinned like pulse/ledger.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = ["MemorySampler", "device_memory_stats", "process_peak_bytes"]
+
+# Process-wide peak of live-array bytes observed by ANY sampler, for
+# the serve /metrics surface (concurrent tenants share one device; the
+# per-process peak is the capacity-relevant number).
+_peak_lock = threading.Lock()
+_process_peak = 0
+
+
+def process_peak_bytes() -> int:
+    with _peak_lock:
+        return _process_peak
+
+
+def _note_process_peak(live_bytes: int) -> None:
+    global _process_peak
+    with _peak_lock:
+        if live_bytes > _process_peak:
+            _process_peak = live_bytes
+
+
+def live_array_bytes() -> Dict[str, int]:
+    """Total bytes + count of live jax arrays (host-side registry walk;
+    no device traffic). Never raises."""
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+        total = 0
+        for a in arrays:
+            try:
+                total += int(a.nbytes)
+            except Exception:  # deleted/donated buffers mid-walk
+                pass
+        return {"live_bytes": total, "live_arrays": len(arrays)}
+    except Exception:  # noqa: BLE001 - sampling must never break the loop
+        return {"live_bytes": 0, "live_arrays": 0}
+
+
+def device_memory_stats() -> Optional[Dict[str, int]]:
+    """Allocator stats from device 0, normalized to the three fields
+    the capacity layer uses — or None where the backend has no
+    allocator introspection (CPU: ``memory_stats()`` is absent, returns
+    None, or raises depending on jax version; all degrade here)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        fn = getattr(dev, "memory_stats", None)
+        if fn is None:
+            return None
+        stats = fn()
+        if not stats:
+            return None
+        out = {}
+        for ours, theirs in (("bytes_in_use", "bytes_in_use"),
+                             ("peak_bytes_in_use", "peak_bytes_in_use"),
+                             ("bytes_limit", "bytes_limit")):
+            v = stats.get(theirs)
+            if v is not None:
+                out[ours] = int(v)
+        return out or None
+    except Exception:  # noqa: BLE001 - backend-optional introspection
+        return None
+
+
+class MemorySampler:
+    """Telemetry-hub sink; see module docstring."""
+
+    def __init__(self, hub, *, detector=None, recorder=None,
+                 degrader=None, emit_every: int = 1) -> None:
+        self.hub = hub
+        self.detector = detector
+        self.degrader = degrader
+        self.emit_every = max(int(emit_every), 1)
+        base = live_array_bytes()
+        # run-start baseline: the deterministic bundle view records
+        # growth relative to this (absolute totals include unrelated
+        # allocations the process already held)
+        self.baseline_bytes = int(base["live_bytes"])
+        self.baseline_arrays = int(base["live_arrays"])
+        self.peak_live_bytes = self.baseline_bytes
+        self.last: Optional[Dict[str, Any]] = None
+        self._det_snapshot: Optional[Dict[str, int]] = None
+        self.phase_peaks: Dict[str, int] = {}
+        if recorder is not None:
+            # recorder pulls the deterministic snapshot per iteration;
+            # attribute hookup (not an import) keeps pulse free of any
+            # gauge dependency
+            recorder.memory_provider = self.deterministic_snapshot
+
+    # -- host-span observer chain --------------------------------------
+    def note_phase(self, name: str, seconds: float) -> None:
+        """Per-phase live-bytes watermark; rides the same (name,
+        seconds) span-observer callback as the cost ledger. Reuses the
+        latest iteration sample rather than re-walking the registry —
+        ``jax.live_arrays()`` is O(live arrays) and spans fire several
+        times per iteration, so a fresh walk here would multiply the
+        sampler's cost by the span count (prohibitive in array-heavy
+        long-lived processes)."""
+        b = (self.last or {}).get("live_bytes", self.baseline_bytes)
+        if b > self.phase_peaks.get(name, -1):
+            self.phase_peaks[name] = b
+
+    # -- recorder hookup -----------------------------------------------
+    def deterministic_snapshot(self) -> Optional[Dict[str, int]]:
+        """The baseline-relative part of the latest sample (what the
+        flight-recorder bundle keeps in its deterministic view)."""
+        return self._det_snapshot
+
+    # -- hub sink protocol ---------------------------------------------
+    def on_iteration(self, ctx) -> None:
+        it = int(ctx.iteration)
+        live = live_array_bytes()
+        live_bytes = int(live["live_bytes"])
+        stats = device_memory_stats()
+        self.peak_live_bytes = max(self.peak_live_bytes, live_bytes)
+        _note_process_peak(live_bytes)
+        self._det_snapshot = {
+            "live_bytes_delta": live_bytes - self.baseline_bytes,
+            "live_arrays_delta": (int(live["live_arrays"])
+                                  - self.baseline_arrays),
+        }
+        sample: Dict[str, Any] = {
+            "live_bytes": live_bytes,
+            "live_arrays": int(live["live_arrays"]),
+            "peak_live_bytes": self.peak_live_bytes,
+            "stats": stats,
+        }
+        self.last = sample
+        if self.detector is not None:
+            observe = getattr(self.detector, "observe_live_bytes", None)
+            if observe is not None:
+                observe(it, live_bytes)
+        if self.degrader is not None:
+            # allocator watermark where the backend has one (that is
+            # what actually OOMs); live-array bytes otherwise
+            watermark = (stats or {}).get("bytes_in_use", live_bytes)
+            limit = (stats or {}).get("bytes_limit")
+            self.degrader.check(it, watermark_bytes=watermark,
+                                limit_bytes=limit)
+        if it % self.emit_every == 0:
+            self.hub.gauge(
+                "memory", iteration=it, live_bytes=live_bytes,
+                live_arrays=int(live["live_arrays"]),
+                peak_live_bytes=self.peak_live_bytes,
+                bytes_in_use=(stats or {}).get("bytes_in_use"),
+                peak_bytes_in_use=(stats or {}).get("peak_bytes_in_use"),
+                bytes_limit=(stats or {}).get("bytes_limit"),
+            )
+
+    def emit_final(self, iteration: int = 0) -> None:
+        # final watermark summary; the search loop calls this right
+        # before hub.finish() so the event lands BEFORE run_end (the
+        # timeline exporter and tail follower read streams in order)
+        self.hub.gauge(
+            "watermark", iteration=int(iteration),
+            peak_live_bytes=self.peak_live_bytes,
+            baseline_bytes=self.baseline_bytes,
+            phase_peaks=(dict(self.phase_peaks)
+                         if self.phase_peaks else None),
+        )

@@ -134,6 +134,22 @@ def _run_stream_events(events: List[dict], pid: int) -> List[dict]:
             out.append(_instant(
                 f"mesh:exchange@{e.get('iteration')}", t, pid,
                 _TID_EVENTS, {**args, "shards": e.get("shards")}))
+        elif kind == "gauge":
+            d = e.get("detail") or {}
+            if e.get("kind") == "memory":
+                # Chrome counter track ("C"): Perfetto renders the
+                # per-iteration live-bytes series as a graph alongside
+                # the iteration slices
+                counters = {"live_bytes": d.get("live_bytes", 0)}
+                if d.get("bytes_in_use") is not None:
+                    counters["bytes_in_use"] = d["bytes_in_use"]
+                out.append({"ph": "C", "name": "memory",
+                            "ts": t * 1e6, "pid": pid,
+                            "tid": _TID_EVENTS, "args": counters})
+            else:
+                out.append(_instant(
+                    f"gauge:{e.get('kind')}", t, pid, _TID_EVENTS,
+                    {**args, "detail": d}))
     return out
 
 

@@ -82,6 +82,12 @@ class MeshIterationExecutable:
     compiled: Any               # jax.stages.Compiled
     cache_key: Optional[str]
     n_devices: int
+    # graftgauge footprint summary (footprint.summarize_compiled),
+    # harvested at compile time and persisted into the serialized
+    # envelope: a replica that *loads* the executable still reports the
+    # same memory/cost analysis even where the deserialized Compiled
+    # can't produce one (backend-optional introspection).
+    analysis: Optional[dict] = None
 
     def run(self, state, data, cur_maxsize):
         """Dispatch one iteration. ``cur_maxsize`` must already be a
@@ -92,15 +98,75 @@ class MeshIterationExecutable:
 
     def cost_analysis(self):
         try:
-            return self.compiled.cost_analysis()
+            out = self.compiled.cost_analysis()
         except Exception:  # noqa: BLE001 - backend-optional introspection
-            return None
+            out = None
+        if out is None and self.analysis is not None:
+            # stamped-envelope fallback: a plain dict (flops / "bytes
+            # accessed"), not the live analysis object
+            return self.analysis.get("cost") or None
+        return out
 
     def memory_analysis(self):
         try:
-            return self.compiled.memory_analysis()
+            out = self.compiled.memory_analysis()
         except Exception:  # noqa: BLE001 - backend-optional introspection
+            out = None
+        if out is None and self.analysis is not None:
+            # stamped-envelope fallback: a plain *_in_bytes dict
+            return self.analysis.get("memory") or None
+        return out
+
+
+def _harvest_analysis(engine, compiled, rows: int) -> Optional[dict]:
+    """Flatten the compiled program's static analyses into the
+    JSON/pickle-able envelope stamp (graftgauge), including the ledger
+    identity (fingerprint + geometry) so a loading replica can re-record
+    the footprint without the engine in hand. Never raises."""
+    try:
+        from ..api.checkpoint import options_fingerprint
+        from ..gauge.footprint import geometry_key, summarize_compiled
+
+        summary = summarize_compiled(compiled)
+        if summary is None:
             return None
+        memory = {k: v for k, v in summary.items()
+                  if k.endswith("_in_bytes")}
+        cost = {}
+        if "flops" in summary:
+            cost["flops"] = summary["flops"]
+        if "bytes_accessed" in summary:
+            cost["bytes accessed"] = summary["bytes_accessed"]
+        nfeatures = int(engine.nfeatures)
+        return {
+            "summary": summary,
+            "memory": memory or None,
+            "cost": cost or None,
+            "fingerprint": options_fingerprint(engine.options),
+            "geometry": geometry_key(rows=rows, nfeatures=nfeatures),
+            "rows": int(rows),
+            "nfeatures": nfeatures,
+        }
+    except Exception:  # noqa: BLE001 - observability is best-effort
+        return None
+
+
+def _record_footprint(analysis: Optional[dict], *, source: str) -> None:
+    """Record a harvested/loaded analysis stamp into the process-wide
+    graftgauge footprint ledger. Never raises."""
+    if not analysis or not analysis.get("summary"):
+        return
+    try:
+        from ..gauge.footprint import global_ledger
+
+        global_ledger().record(
+            analysis.get("fingerprint"), analysis.get("geometry") or "",
+            analysis.get("summary"), source=source,
+            rows=analysis.get("rows"), nfeatures=analysis.get("nfeatures"),
+            nout=1,
+        )
+    except Exception:  # noqa: BLE001 - observability is best-effort
+        pass
 
 
 def compile_iteration(engine, state, data, cur_maxsize=None
@@ -112,6 +178,10 @@ def compile_iteration(engine, state, data, cur_maxsize=None
     ``_iteration`` is the override point); the compiled program bakes in
     the engine's current launch geometry, so a graftshield degrade
     (which rebuilds the jits) invalidates it — build a fresh one.
+
+    The compile also harvests the program's memory/cost analysis into
+    the graftgauge footprint ledger (source ``mesh_aot``) and stamps it
+    onto the executable for the serialized envelope.
     """
     if cur_maxsize is None:
         cur_maxsize = jnp.int32(engine.cfg.maxsize)
@@ -119,10 +189,14 @@ def compile_iteration(engine, state, data, cur_maxsize=None
         cur_maxsize = jnp.int32(cur_maxsize)
     lowered = engine._iteration.lower(state, data, cur_maxsize)
     compiled = lowered.compile()
+    rows = int(data.y.shape[0])
+    analysis = _harvest_analysis(engine, compiled, rows)
+    _record_footprint(analysis, source="mesh_aot")
     return MeshIterationExecutable(
         compiled=compiled,
-        cache_key=aot_cache_key(engine, rows=data.y.shape[0]),
+        cache_key=aot_cache_key(engine, rows=rows),
         n_devices=getattr(engine, "n_island_shards", 1),
+        analysis=analysis,
     )
 
 
@@ -143,6 +217,9 @@ def save_executable(ex: MeshIterationExecutable, path: str) -> str:
         "payload": payload,
         "in_tree": in_tree,
         "out_tree": out_tree,
+        # additive graftgauge stamp (version stays 1: old loaders use
+        # rec.get and old payloads load with analysis=None)
+        "analysis": ex.analysis,
     })
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -170,8 +247,16 @@ def load_executable(path: str, expect_key: Optional[str] = None
             f"different options/geometry/backend) — recompile instead")
     compiled = deserialize_and_load(
         rec["payload"], rec["in_tree"], rec["out_tree"])
+    analysis = rec.get("analysis")
+    if isinstance(analysis, dict):
+        # a loaded replica reports the footprint too — both through the
+        # executable's analysis fallbacks and on this process's ledger
+        _record_footprint(analysis, source="aot_load")
+    else:
+        analysis = None
     return MeshIterationExecutable(
         compiled=compiled,
         cache_key=rec.get("cache_key"),
         n_devices=int(rec.get("n_devices", 1)),
+        analysis=analysis,
     )

@@ -30,6 +30,12 @@ Watched metrics (docs/OBSERVABILITY.md has the threshold table):
   unrescored-candidate NaN floor (screen_rows - rescore_rows,
   docs/PRECISION.md) is subtracted first — the rule watches the
   rescored candidates, which a genuine storm still poisons.
+- ``live_bytes_growth`` — the graftgauge leak tripwire: live-array
+  bytes strictly increasing over ``leak_window`` consecutive
+  iterations by at least ``leak_min_bytes`` total (fed by
+  gauge/sampler.py via :meth:`AnomalyDetector.observe_live_bytes`);
+  the anomaly also triggers the flight-recorder bundle dump, so the
+  memory snapshot lands on disk at the moment of detection.
 
 Bit-neutral by construction: reads only host-side values the loop
 already materialized, never touches state, keys, or options.
@@ -63,6 +69,17 @@ class AnomalyThresholds:
     # means the compiled program and the host-side config disagree —
     # a stale AOT executable or a mis-threaded knob.
     rescore_drift_tol: float = 0.2
+    # graftgauge leak tripwire: live-array bytes (gauge/sampler.py)
+    # growing STRICTLY monotonically over leak_window consecutive
+    # iteration samples, by at least leak_min_bytes in total, fires a
+    # live_bytes_growth anomaly (which also triggers the flight-
+    # recorder bundle dump — recorder.py). A healthy search plateaus
+    # after warmup (populations are fixed-size, loop temporaries are
+    # freed functionally); unbroken growth means something is
+    # accumulating references. The byte floor keeps small-object churn
+    # (HoF growth toward its cap, python-side caches) below the rule.
+    leak_window: int = 8
+    leak_min_bytes: int = 1 << 20
 
 
 class _Rolling:
@@ -120,6 +137,12 @@ class AnomalyDetector:
         self._last_elapsed: Optional[float] = None
         self._last_traces: Optional[int] = None
         self._samples = 0
+        # graftgauge leak tripwire state: the live-bytes value at the
+        # start of the current strictly-increasing streak, the previous
+        # sample, and the streak length
+        self._leak_base: Optional[int] = None
+        self._leak_prev: Optional[int] = None
+        self._leak_streak = 0
 
     # ------------------------------------------------------------------
     def _fire(self, metric: str, iteration: int, **detail) -> None:
@@ -158,6 +181,34 @@ class AnomalyDetector:
                     zscore=round(z, 3), threshold=self.t.zscore,
                 )
         roll.update(obs)
+
+    # -- graftgauge leak tripwire --------------------------------------
+    def observe_live_bytes(self, iteration: int, live_bytes: int) -> None:
+        """One per-iteration live-array byte sample (fed by
+        gauge/sampler.py, not the hub sink protocol — the sampler runs
+        as its own sink and hands the value here so the leak rule
+        shares the detector's cooldown/budget/capture-arming plumbing).
+
+        Fires ``live_bytes_growth`` after ``leak_window`` consecutive
+        strictly-increasing samples whose total growth is at least
+        ``leak_min_bytes``; any non-increase resets the streak."""
+        b = int(live_bytes)
+        if self._leak_prev is not None and b > self._leak_prev:
+            self._leak_streak += 1
+        else:
+            self._leak_streak = 0
+            self._leak_base = b
+        if self._leak_base is None:
+            self._leak_base = b
+        self._leak_prev = b
+        growth = b - self._leak_base
+        if (self._leak_streak >= self.t.leak_window
+                and growth >= self.t.leak_min_bytes):
+            self._fire(
+                "live_bytes_growth", int(iteration), value=b,
+                growth_bytes=growth, window=self._leak_streak,
+                threshold=self.t.leak_window,
+            )
 
     # -- hub sink protocol ---------------------------------------------
     def on_iteration(self, ctx) -> None:

@@ -10,9 +10,31 @@ text format v0.0.4: one ``# HELP`` / ``# TYPE`` pair per metric family
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
-__all__ = ["PromText"]
+__all__ = ["PromText", "histogram_quantile"]
+
+
+def histogram_quantile(le_bounds: Sequence[float],
+                       counts: Sequence[int],
+                       q: float) -> Optional[float]:
+    """Bucket-upper-bound quantile estimate over a pre-bucketed
+    histogram (``counts`` may carry the extra +Inf slot past
+    ``le_bounds``). Returns the upper bound of the bucket containing
+    the q-th sample — the same coarse-but-honest estimate a
+    ``histogram_quantile()`` PromQL query makes — or None while empty.
+    Samples in the +Inf bucket report the last finite bound (a floor,
+    not a fabricated extrapolation)."""
+    total = sum(int(n) for n in counts)
+    if total <= 0 or not le_bounds:
+        return None
+    target = max(min(float(q), 1.0), 0.0) * total
+    cum = 0
+    for i, n in enumerate(counts):
+        cum += int(n)
+        if cum >= target and cum > 0:
+            return float(le_bounds[min(i, len(le_bounds) - 1)])
+    return float(le_bounds[-1])
 
 
 def _escape_label(value: str) -> str:

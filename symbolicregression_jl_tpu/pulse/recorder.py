@@ -47,6 +47,13 @@ BUNDLE_SCHEMA = "graftpulse.bundle.v1"
 # funnel — every shield recovery path emits a fault event)
 _DUMP_TRIGGERS = ("fault",)
 
+# anomaly metrics that ALSO trigger a dump: most anomalies arm a
+# profiler capture instead (slow != dying), but the graftgauge leak
+# tripwire wants the bundle — its deterministic memory snapshots ARE
+# the leak evidence, and a leak that later OOMs may take the process
+# with it before any fault event fires
+_DUMP_ANOMALY_METRICS = ("live_bytes_growth",)
+
 
 def _finite(x) -> Optional[float]:
     try:
@@ -85,9 +92,24 @@ class FlightRecorder:
             maxlen=self.capacity)
         self._events: collections.deque = collections.deque(
             maxlen=max(int(event_capacity), 1))
+        # graftgauge hookup (attribute, not a constructor arg or
+        # import — pulse stays gauge-free): when a MemorySampler is
+        # wired, it points this at its deterministic_snapshot and the
+        # per-iteration records gain a baseline-relative "memory" view
+        # (docs/OBSERVABILITY.md "Capacity & memory"). Deltas, not
+        # absolutes, so the bundle byte-stability contract holds: what
+        # the RUN allocated is reproducible; what the process already
+        # held is not.
+        self.memory_provider: Optional[Any] = None
 
     # -- hub sink protocol ---------------------------------------------
     def on_iteration(self, ctx) -> None:
+        memory = None
+        if self.memory_provider is not None:
+            try:
+                memory = self.memory_provider()
+            except Exception:  # observation must never break the ring
+                memory = None
         det = {
             "iteration": int(ctx.iteration),
             "num_evals": float(ctx.num_evals),
@@ -96,6 +118,7 @@ class FlightRecorder:
             # pulled them (hub.iteration); None otherwise — the
             # recorder never adds a transfer of its own
             "counters": list(ctx.counters) if ctx.counters else None,
+            "memory": memory,
         }
         wall = {
             "iteration": int(ctx.iteration),
@@ -117,6 +140,13 @@ class FlightRecorder:
             self.dump(trigger={
                 "reason": "fault",
                 "kind": event.get("kind"),
+                "iteration": event.get("iteration", 0),
+            })
+        elif (event.get("event") == "anomaly"
+              and event.get("metric") in _DUMP_ANOMALY_METRICS):
+            self.dump(trigger={
+                "reason": "anomaly",
+                "kind": event.get("metric"),
                 "iteration": event.get("iteration", 0),
             })
 

@@ -85,6 +85,14 @@ class AdmissionDecision:
     sample_rows: Optional[int]
     level: str
     utilization: float
+    # graftgauge memory advisory (capacity.HeadroomModel.advise):
+    # predicted program bytes vs the device byte budget for this shape
+    # bucket, or None when no headroom model is attached / the ledger
+    # has no history for the shape. ADVISORY ONLY — admission never
+    # rejects on it (a floor estimate's false "no" would be an outage);
+    # it is recorded on the accept audit event for operators to alert
+    # on.
+    memory: Optional[dict] = None
 
 
 class AdmissionController:
@@ -104,6 +112,8 @@ class AdmissionController:
         bucket_capacity: Optional[int] = None,
         ladder: Optional[OverloadLadder] = None,
         default_retry_after_s: float = 5.0,
+        headroom=None,
+        memory_limit_bytes: Optional[int] = None,
     ) -> None:
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
@@ -113,6 +123,12 @@ class AdmissionController:
         )
         self.ladder = ladder or OverloadLadder()
         self.default_retry_after_s = float(default_retry_after_s)
+        # graftgauge memory-aware admission (docs/SERVING.md): a
+        # capacity.HeadroomModel whose advisory is attached to every
+        # admitted decision; memory_limit_bytes overrides the backend
+        # allocator limit (the only source on CPU)
+        self.headroom = headroom
+        self.memory_limit_bytes = memory_limit_bytes
         self._lock = threading.Lock()
         self._in_flight: Dict[Tuple[int, int, int], int] = {}
         self._total = 0
@@ -153,6 +169,16 @@ class AdmissionController:
               ) -> AdmissionDecision:
         """Admit (and count) one request, or raise ServerSaturated."""
         bucket = shape_bucket(n_rows, nfeatures, nout)
+        # memory advisory BEFORE taking the admission lock: advise()
+        # reads the footprint ledger (its own lock) and the backend
+        # allocator — neither may nest inside self._lock
+        memory = None
+        if self.headroom is not None:
+            try:
+                memory = self.headroom.advise(
+                    bucket=bucket, limit_bytes=self.memory_limit_bytes)
+            except Exception:  # noqa: BLE001 - advisory is best-effort
+                memory = None
         with self._lock:
             util = self._total / self.capacity
             bucket_depth = self._in_flight.get(bucket, 0)
@@ -187,6 +213,7 @@ class AdmissionController:
                 priority=shed["priority"],
                 sample_rows=shed["sample_rows"],
                 level=shed["level"], utilization=util,
+                memory=memory,
             )
 
     def readmit(self, bucket: Tuple[int, int, int]) -> None:

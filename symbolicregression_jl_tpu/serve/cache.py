@@ -79,6 +79,24 @@ class ExecutableCache:
                 pass
 
     @staticmethod
+    def _footprint_bytes(fp: Optional[str]) -> Optional[int]:
+        """Known footprint for a config fingerprint from the graftgauge
+        ledger (largest geometry recorded), stamped onto cache hit/miss
+        telemetry. The cache itself never compiles eagerly (the jit
+        caches on the engine are lazy), so this is read-only bookkeeping
+        — None until some compile site has recorded the config."""
+        try:
+            from ..gauge import global_ledger
+
+            entry = global_ledger().lookup(fp)
+            if entry is None:
+                return None
+            total = (entry.get("summary") or {}).get("total_bytes")
+            return int(total) if total else None
+        except Exception:  # noqa: BLE001 - audit detail is best-effort
+            return None
+
+    @staticmethod
     def _mesh_key(mesh) -> Tuple:
         try:
             return (
@@ -141,7 +159,8 @@ class ExecutableCache:
                 self.hits += 1
         if engine is not None:
             self._note("cache_hit", bucket,
-                       {"bucket": list(bucket), "rows": int(rows)})
+                       {"bucket": list(bucket), "rows": int(rows),
+                        "footprint_bytes": self._footprint_bytes(fp)})
             return engine
         from ..evolve.engine import Engine
 
@@ -168,7 +187,8 @@ class ExecutableCache:
                 self._entries[key] = engine
             self.misses += 1
         self._note("cache_miss", bucket,
-                   {"bucket": list(bucket), "rows": int(rows)})
+                   {"bucket": list(bucket), "rows": int(rows),
+                    "footprint_bytes": self._footprint_bytes(fp)})
         return engine
 
     # ------------------------------------------------------------------

@@ -22,7 +22,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
-__all__ = ["MetricsServer", "CONTENT_TYPE", "render_ledger_metrics"]
+__all__ = ["MetricsServer", "CONTENT_TYPE", "render_ledger_metrics",
+           "render_gauge_metrics"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -67,6 +68,42 @@ def render_ledger_metrics(p, rollup: Optional[dict]) -> None:
               "Ledger-attributed device seconds, all requests")
     p.counter("ledger_evals_total", totals.get("num_evals", 0.0),
               "Cumulative expression evaluations, all requests")
+
+
+def render_gauge_metrics(p) -> None:
+    """Append the graftgauge capacity section to a ``PromText`` builder:
+    the process-wide dispatch-latency histogram, the peak live-array
+    bytes any search in this process reached, and one ``footprint_bytes``
+    gauge per footprint-ledger entry (fingerprint truncated to 12 hex
+    chars — a label, not a join key; the full value is in the gauge
+    events and the ledger API). All reads of process-global state;
+    never raises into a scrape."""
+    try:
+        from ..gauge import global_latency, global_ledger, process_peak_bytes
+
+        global_latency().render(p)
+        p.gauge(
+            "process_peak_live_bytes", process_peak_bytes(),
+            "Peak live jax-array bytes observed by any search "
+            "in this process",
+        )
+        for e in global_ledger().entries():
+            total = (e.get("summary") or {}).get("total_bytes")
+            if not total:
+                continue
+            fp = e.get("fingerprint") or ""
+            p.gauge(
+                "footprint_bytes", int(total),
+                "Compiled-program footprint (temp+args+output+aliases"
+                "+code) from XLA memory analysis",
+                {
+                    "fingerprint": fp[:12] or "none",
+                    "geometry": e.get("geometry", ""),
+                    "source": e.get("source", ""),
+                },
+            )
+    except Exception:  # noqa: BLE001 - a scrape must not 500 on gauge
+        pass
 
 
 class MetricsServer:

@@ -295,11 +295,16 @@ class SearchServer:
             os.path.join(self.root, "requests.jsonl"),
             injector=self._injector,
         )
+        from ..gauge import HeadroomModel
         from ..shield.degrade import OverloadLadder
 
         self.admission = AdmissionController(
             capacity, bucket_capacity=bucket_capacity,
             ladder=ladder or OverloadLadder(telemetry=self.log),
+            # graftgauge memory advisory: predicted footprint vs device
+            # budget, attached to every accept record (advisory only —
+            # see AdmissionController; docs/SERVING.md)
+            headroom=HeadroomModel(),
         )
         self.cache = cache or ExecutableCache(
             on_event=self._on_cache_event)
@@ -557,6 +562,7 @@ class SearchServer:
             priority=decision.priority,
             sample_rows=decision.sample_rows,
             level=decision.level, queue_depth=self.admission.depth,
+            memory=decision.memory,
         )
         with self._lock:
             rec.journaled = True
@@ -667,9 +673,12 @@ class SearchServer:
         # seconds, evals, checkpoint bytes, and the log-bucketed
         # iteration-latency histogram per request, from the rollup the
         # completion path maintains (ledger/rollup.py)
-        from .metrics import render_ledger_metrics
+        from .metrics import render_gauge_metrics, render_ledger_metrics
 
         render_ledger_metrics(p, load_rollup(self.root))
+        # graftgauge capacity section: dispatch-latency histogram, peak
+        # live bytes, per-entry compiled-program footprints
+        render_gauge_metrics(p)
         return p.render()
 
     # ------------------------------------------------------------------

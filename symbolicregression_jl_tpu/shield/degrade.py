@@ -45,8 +45,22 @@ __all__ = [
 # Substrings (case-sensitive, matching XLA/gRPC status spellings) that
 # mark a failure as worth retrying. Buffer-deleted / donation errors are
 # deliberately NOT here: retrying them can only fail again.
+#
+# OOM spellings vary by allocator layer: the gRPC status name
+# ("RESOURCE_EXHAUSTED") appears in distributed-runtime errors, but
+# jaxlib's XlaRuntimeError from a local BFC-allocator failure reads
+# "Resource exhausted: Out of memory while trying to allocate N bytes",
+# and the TPU runtime emits "Failed to allocate request for ...". All
+# of them must classify as transient AND as OOM-shaped, or the degrade
+# ladder never gets a chance (ShieldRunner re-raises non-transient
+# failures immediately) — every _OOM_MARKERS entry therefore also
+# appears here.
 TRANSIENT_MARKERS = (
     "RESOURCE_EXHAUSTED",
+    "Resource exhausted",
+    "Out of memory",
+    "out of memory",
+    "Failed to allocate",
     "UNAVAILABLE",
     "ABORTED",
     "DEADLINE_EXCEEDED",
@@ -54,7 +68,13 @@ TRANSIENT_MARKERS = (
     "compilation cache",
 )
 
-_OOM_MARKERS = ("RESOURCE_EXHAUSTED",)
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Resource exhausted",
+    "Out of memory",
+    "out of memory",
+    "Failed to allocate",
+)
 
 
 def is_transient_failure(exc: BaseException) -> bool:

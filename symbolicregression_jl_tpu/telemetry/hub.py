@@ -284,7 +284,7 @@ class Telemetry:
     def add_watcher(self, fn: Callable[[Dict[str, Any]], None]
                     ) -> "Telemetry":
         """Register an out-of-band event observer: called with every
-        fault/mesh/anomaly/pulse event dict, stream on or off. Watcher
+        fault/mesh/anomaly/pulse/gauge event dict, stream on or off. Watcher
         exceptions are swallowed — observation must never break the
         path it observes (the same contract sinks have)."""
         self._watchers.append(fn)
@@ -371,6 +371,28 @@ class Telemetry:
         dumps, profiler-unusable warnings."""
         event = {
             "event": "pulse",
+            "kind": str(kind),
+            "iteration": int(iteration),
+            "detail": {k: v for k, v in detail.items() if v is not None},
+        }
+        if self.path is not None:
+            try:
+                self._emit(event)
+            except OSError:
+                pass
+        self._notify(event)
+        return event
+
+    def gauge(self, kind: str, *, iteration: int = 0,
+              **detail) -> Dict[str, Any]:
+        """Record a graftgauge capacity-observability event (schema
+        ``gauge``): per-iteration memory samples, compiled-executable
+        footprints, end-of-run watermarks and dispatch-latency
+        summaries. Same discipline as ``pulse``: streamed when the
+        JSONL stream is on, watchers notified either way, never raises
+        into the loop it observes."""
+        event = {
+            "event": "gauge",
             "kind": str(kind),
             "iteration": int(iteration),
             "detail": {k: v for k, v in detail.items() if v is not None},

@@ -339,6 +339,42 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
             "bundles": pk.get("bundle_dump", 0),
         }
 
+    # graftgauge capacity view (docs/OBSERVABILITY.md, "Capacity &
+    # memory"): peak live bytes across memory/watermark events, the
+    # end-of-run dispatch-latency histogram summary, and the footprint
+    # events' count + largest program.
+    gauges = [e for e in events if e["event"] == "gauge"]
+    if gauges:
+        gk: Dict[str, int] = {}
+        peak_live = None
+        latency = None
+        footprint_max = None
+        for e in gauges:
+            gk[e["kind"]] = gk.get(e["kind"], 0) + 1
+            d = e.get("detail", {})
+            if e["kind"] in ("memory", "watermark"):
+                p = d.get("peak_live_bytes", d.get("live_bytes"))
+                if p is not None and (peak_live is None or p > peak_live):
+                    peak_live = p
+            elif e["kind"] == "dispatch_latency":
+                latency = {
+                    k: d.get(k)
+                    for k in ("count", "sum_s", "max_s", "p50_s", "p99_s")
+                }
+            elif e["kind"] == "footprint":
+                total = (d.get("summary") or {}).get("total_bytes")
+                if total and (footprint_max is None
+                              or total > footprint_max):
+                    footprint_max = total
+        summary["gauge"] = {
+            "count": len(gauges),
+            "by_kind": gk,
+            "peak_live_bytes": peak_live,
+            "dispatch_latency": latency,
+            "footprints": gk.get("footprint", 0),
+            "footprint_max_bytes": footprint_max,
+        }
+
     # graftserve per-request view (docs/SERVING.md): the serve event
     # stream always gets one; a plain search stream gets one only when
     # it actually interleaves multiple run_ids.
@@ -415,6 +451,11 @@ def metrics_view(summary: Dict[str, Any]) -> Dict[str, Any]:
         # bench artifacts via extract.py (extra metrics_view keys are
         # carried along) and colors `bench trend`'s anomalies column.
         "anomalies": (summary.get("anomalies") or {}).get("count", 0),
+        # graftgauge: peak live-array bytes the run reached (None for
+        # pre-gauge streams / gauge off); rides into bench cells the
+        # same way and shows in `bench trend`.
+        "peak_live_bytes": (summary.get("gauge")
+                            or {}).get("peak_live_bytes"),
     }
 
 
@@ -534,6 +575,27 @@ def format_report(summary: Dict[str, Any]) -> str:
                         for k, v in sorted(pu["by_kind"].items()))
             + ")"
         )
+    ga = summary.get("gauge")
+    if ga:
+        lines.append(
+            f"gauge: peak live {_fmt_num(ga.get('peak_live_bytes'))} B  ("
+            + ", ".join(f"{k}={v}"
+                        for k, v in sorted(ga["by_kind"].items()))
+            + ")"
+        )
+        dl = ga.get("dispatch_latency")
+        if dl and dl.get("count"):
+            lines.append(
+                f"  dispatch latency: {dl['count']} launches, "
+                f"p50 {_fmt_num(dl.get('p50_s'))}s, "
+                f"p99 {_fmt_num(dl.get('p99_s'))}s, "
+                f"max {_fmt_num(dl.get('max_s'))}s"
+            )
+        if ga.get("footprints"):
+            lines.append(
+                f"  footprints: {ga['footprints']} compiled program(s), "
+                f"largest {_fmt_num(ga.get('footprint_max_bytes'))} B"
+            )
     ms = summary.get("mesh")
     if ms:
         lines.append(

@@ -120,6 +120,20 @@ EVENT_SPECS: Dict[str, Dict[str, Any]] = {
         "iteration": int,
         "detail": dict,
     },
+    # graftgauge capacity-observability records (docs/OBSERVABILITY.md,
+    # "Capacity & memory"): kind is one of memory (per-iteration live
+    # bytes + allocator stats) / footprint (one compiled executable's
+    # memory/cost analysis) / watermark (end-of-run peaks) /
+    # dispatch_latency (end-of-run histogram summary); detail carries
+    # kind-specific fields. Additive within graftscope.v2 — the schema
+    # allows unknown event fields but not unknown event TYPES, so the
+    # entry here is what lets v2 consumers see gauge streams; v1
+    # streams (which never contain gauge events) validate unchanged.
+    "gauge": {
+        "kind": str,
+        "iteration": int,
+        "detail": dict,
+    },
 }
 
 # required keys inside each element of iteration.outputs; nullable

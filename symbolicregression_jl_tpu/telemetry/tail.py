@@ -41,6 +41,10 @@ class TailState:
         self.anomalies: Dict[str, int] = {}
         self.pulse: Dict[str, int] = {}
         self.serve: Dict[str, int] = {}
+        self.gauge: Dict[str, int] = {}
+        # latest graftgauge memory sample (live/peak bytes), for the
+        # "is it about to OOM" line
+        self.last_memory: Optional[Dict[str, Any]] = None
         self.mesh_exchanges = 0
         self.end: Optional[Dict[str, Any]] = None
         self.events = 0
@@ -66,6 +70,11 @@ class TailState:
         elif ev == "serve":
             k = e.get("kind", "?")
             self.serve[k] = self.serve.get(k, 0) + 1
+        elif ev == "gauge":
+            k = e.get("kind", "?")
+            self.gauge[k] = self.gauge.get(k, 0) + 1
+            if k in ("memory", "watermark"):
+                self.last_memory = e.get("detail") or {}
         elif ev == "mesh":
             self.mesh_exchanges += 1
         elif ev == "run_end":
@@ -98,9 +107,24 @@ class TailState:
                 lines.append(f"  recompiles this event: {rc['traces']}")
         else:
             lines.append("iteration -  (no iteration events yet)")
+        mem = self.last_memory
+        if mem:
+            live = mem.get("live_bytes")
+            peak = mem.get("peak_live_bytes")
+            in_use = mem.get("bytes_in_use")
+            bits = []
+            if live is not None:
+                bits.append(f"live {live:,} B")
+            if peak is not None:
+                bits.append(f"peak {peak:,} B")
+            if in_use is not None:
+                bits.append(f"allocator {in_use:,} B")
+            if bits:
+                lines.append("memory: " + "  |  ".join(bits))
         for label, counts in (("faults", self.faults),
                               ("anomalies", self.anomalies),
                               ("pulse", self.pulse),
+                              ("gauge", self.gauge),
                               ("serve", self.serve)):
             if counts:
                 body = ", ".join(

@@ -90,6 +90,7 @@ _MINIMAL_FIELDS = {
     "mesh": dict(iteration=1, shards=2, detail={}),
     "anomaly": dict(metric="evals_per_sec", iteration=1, detail={}),
     "pulse": dict(kind="capture_armed", iteration=1, detail={}),
+    "gauge": dict(kind="memory", iteration=1, detail={}),
 }
 
 

@@ -38,6 +38,9 @@ JAX_PLATFORMS=cpu python tools/serve_smoke.py
 echo "== graftledger: cost attribution + trace + timeline smoke (docs/OBSERVABILITY.md) =="
 JAX_PLATFORMS=cpu python tools/ledger_smoke.py
 
+echo "== graftgauge: capacity observability smoke (docs/OBSERVABILITY.md) =="
+JAX_PLATFORMS=cpu python tools/gauge_smoke.py
+
 echo "== graftmesh: mesh dryrun fast tier (docs/SCALING.md) =="
 JAX_PLATFORMS=cpu python -m symbolicregression_jl_tpu.mesh.dryrun \
     --devices 8 --fast --out "${TMPDIR:-/tmp}/graftmesh/dryrun.json"

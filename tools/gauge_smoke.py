@@ -1,0 +1,263 @@
+"""CI graftgauge smoke: capacity observability end to end on CPU
+(docs/OBSERVABILITY.md "Capacity & memory"; tools/check.sh and the CI
+``gauge-smoke`` job)::
+
+    python tools/gauge_smoke.py [out_dir]
+
+Four scenarios:
+
+1. **leak→anomaly→bundle**: a full ``equation_search`` whose logger
+   hook leaks one growing device array per iteration (the synthetic
+   leak). The memory sampler's per-iteration live-byte samples must
+   trip the detector's ``live_bytes_growth`` rule, which must dump a
+   flight-recorder bundle (trigger reason ``anomaly``) whose
+   deterministic view carries the baseline-relative memory snapshot;
+   the stream must still validate and ``metrics_view`` must expose
+   ``peak_live_bytes``.
+2. **AOT footprint round-trip**: ``compile_iteration`` must harvest
+   the executable's memory/cost analysis into the footprint ledger and
+   stamp it into the saved envelope; after clearing the ledger,
+   ``load_executable`` must report the same analysis WITHOUT
+   recompiling and re-record it (source ``aot_load``).
+3. **proactive degrade from the watermark**: a search with
+   ``gauge_headroom_fraction=0.5`` and a deliberately tiny
+   ``gauge_limit_bytes`` must step ``eval_tile_rows`` down via
+   ``proactive_degrade`` fault events and still finish cleanly — the
+   degrade fires from the watermark, never from an OOM exception.
+4. **/metrics scrape**: a serve scrape must render the process-wide
+   dispatch-latency histogram (fed by scenarios 1 and 3), the
+   ``process_peak_live_bytes`` gauge, and one ``footprint_bytes``
+   entry per ledger record (fed by scenario 2).
+
+Exits nonzero on the first failed scenario; telemetry JSONL and the
+bundle are left under ``<out_dir>`` as the CI artifact either way.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SYMBOLIC_REGRESSION_IS_TESTING", "true")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def _problem():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2.0, 2.0, (128, 2)).astype(np.float32)
+    y = (X[:, 0] * 2.0 + X[:, 1] * X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options(out_base, **kw):
+    from symbolicregression_jl_tpu import Options
+
+    base = dict(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=2,
+        tournament_selection_n=4,
+        optimizer_probability=0.0,
+        output_directory=out_base,
+        telemetry=True,
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _events(out_base, run_id, event):
+    path = os.path.join(out_base, run_id, "telemetry.jsonl")
+    with open(path) as f:
+        return [json.loads(l) for l in f
+                if f'"event": "{event}"' in l]
+
+
+class _LeakLogger:
+    """SRLogger-compatible hook that leaks one growing device array per
+    iteration: strictly increasing live bytes, > the detector's
+    ``leak_min_bytes`` (1 MiB) within its ``leak_window`` (8)."""
+
+    def __init__(self):
+        self.sink = []
+
+    def log_iteration(self, *, iteration, hofs, states, options,
+                      num_evals, elapsed, **kw):
+        import jax.numpy as jnp
+
+        # 256 KiB, growing per iteration so the walk is strictly
+        # increasing even if something else frees memory between samples
+        n = 65536 + iteration * 1024
+        self.sink.append(jnp.ones((n,), jnp.float32) * iteration)
+
+
+def scenario_leak_anomaly_bundle(out_base) -> None:
+    from symbolicregression_jl_tpu import equation_search
+    from symbolicregression_jl_tpu.api.search import RuntimeOptions
+    from symbolicregression_jl_tpu.pulse import validate_bundle
+    from symbolicregression_jl_tpu.telemetry.report import (
+        metrics_view,
+        summarize,
+    )
+    from symbolicregression_jl_tpu.telemetry.schema import load_events
+
+    X, y = _problem()
+    leak = _LeakLogger()
+    equation_search(
+        X, y, options=_options(out_base),
+        runtime_options=RuntimeOptions(
+            niterations=14, run_id="smoke-leak", seed=5, verbosity=0,
+            logger=leak))
+    assert leak.sink, "leak hook never ran"
+
+    run_dir = os.path.join(out_base, "smoke-leak")
+    events = load_events(os.path.join(run_dir, "telemetry.jsonl"))
+
+    kinds = {e["kind"] for e in events if e["event"] == "gauge"}
+    assert {"memory", "watermark"} <= kinds, kinds
+
+    anomalies = [e for e in events if e["event"] == "anomaly"
+                 and e["metric"] == "live_bytes_growth"]
+    assert anomalies, "synthetic leak never tripped live_bytes_growth"
+    assert anomalies[0]["detail"]["growth_bytes"] >= 1 << 20
+
+    bundle_path = os.path.join(run_dir, "pulse_bundle.json")
+    assert os.path.exists(bundle_path), f"no bundle at {bundle_path}"
+    with open(bundle_path) as f:
+        bundle = json.load(f)
+    errors = validate_bundle(bundle)
+    assert not errors, f"bundle failed validation: {errors}"
+    trig = bundle["trigger"]
+    assert trig["reason"] == "anomaly", trig
+    assert trig["kind"] == "live_bytes_growth", trig
+    memory = bundle["iterations"][-1]["memory"]
+    assert memory is not None, "bundle iteration lacks memory snapshot"
+    assert memory["live_bytes_delta"] > 0, memory
+
+    # the bench layer's ride-along metric comes from the same stream
+    mv = metrics_view(summarize(events))
+    assert mv.get("peak_live_bytes"), mv.get("peak_live_bytes")
+
+
+def scenario_aot_footprint_roundtrip(out_base) -> None:
+    import numpy as np
+    import jax
+
+    from symbolicregression_jl_tpu import Options, search_key
+    from symbolicregression_jl_tpu.core.dataset import make_dataset
+    from symbolicregression_jl_tpu.gauge import global_ledger
+    from symbolicregression_jl_tpu.mesh import MeshEngine, MeshPlan
+    from symbolicregression_jl_tpu.mesh.aot import (
+        aot_serialization_supported,
+        compile_iteration,
+        load_executable,
+        save_executable,
+    )
+
+    rng = np.random.default_rng(7)
+    X = rng.uniform(-2, 2, (48, 2)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1]).astype(np.float32)
+    ds = make_dataset(X, y)
+    options = Options(
+        binary_operators=["+", "-", "*"], unary_operators=[],
+        maxsize=8, populations=2, population_size=8,
+        ncycles_per_iteration=2, tournament_selection_n=4,
+        optimizer_probability=0.0, save_to_file=False)
+    plan = MeshPlan.build(jax.devices()[:1], n_island_shards=1)
+    engine = MeshEngine(options, ds.nfeatures, plan)
+    state = plan.place_state(
+        engine.init_state(search_key(11), ds.data, options.populations))
+
+    ex = compile_iteration(engine, state, ds.data)
+    assert ex.analysis is not None, "compile harvested no analysis"
+    assert ex.analysis["summary"].get("total_bytes") is not None
+    entry = global_ledger().lookup(ex.analysis["fingerprint"],
+                                   ex.analysis["geometry"])
+    assert entry is not None and entry["source"] == "mesh_aot", entry
+
+    if not aot_serialization_supported():
+        print("     (aot serialization unsupported on this jax build; "
+              "round-trip leg skipped)")
+        return
+    path = save_executable(ex, os.path.join(out_base, "iter.aotx"))
+    global_ledger().clear()
+    ex2 = load_executable(path, expect_key=ex.cache_key)
+    # the loaded replica reports footprint from the stamped envelope —
+    # no engine, no recompile
+    assert ex2.analysis == ex.analysis
+    assert ex2.memory_analysis() is not None
+    entry = global_ledger().lookup(ex.analysis["fingerprint"],
+                                   ex.analysis["geometry"])
+    assert entry is not None and entry["source"] == "aot_load", entry
+
+
+def scenario_proactive_degrade(out_base) -> None:
+    from symbolicregression_jl_tpu import equation_search
+    from symbolicregression_jl_tpu.api.search import RuntimeOptions
+
+    X, y = _problem()
+    # eval_tile_rows starts at 2048 (two halvings above the 512 floor);
+    # a 1-byte limit with headroom_fraction=0.5 makes EVERY watermark
+    # cross the threshold, so the ladder steps down on iteration 1 and
+    # again after the 2-iteration cooldown — all from the watermark, no
+    # exception anywhere in the run.
+    equation_search(
+        X, y, options=_options(out_base, eval_tile_rows=2048),
+        runtime_options=RuntimeOptions(
+            niterations=8, run_id="smoke-degrade", seed=5, verbosity=0,
+            gauge_headroom_fraction=0.5, gauge_limit_bytes=1))
+
+    faults = [e for e in _events(out_base, "smoke-degrade", "fault")
+              if e["kind"] == "proactive_degrade"]
+    assert faults, "watermark never fired a proactive_degrade"
+    first = faults[0]["detail"]
+    assert first["eval_tile_rows"] == 1024, first
+    assert first["watermark_bytes"] > first["limit_bytes"], first
+    # run_end proves the search FINISHED after degrading — the step-down
+    # was proactive, not an OOM crash-recovery
+    assert _events(out_base, "smoke-degrade", "run_end")
+
+
+def scenario_metrics_scrape(out_base) -> None:
+    from symbolicregression_jl_tpu.serve.server import SearchServer
+
+    server = SearchServer(os.path.join(out_base, "serve_root"),
+                          capacity=2, telemetry=False)
+    text = server.metrics_text()
+    # scenarios 1/3 fed the process-wide latency aggregate; scenario 2
+    # left a ledger entry; the sampler tracked the process peak
+    assert "graftserve_dispatch_latency_seconds_bucket" in text, (
+        "no dispatch-latency histogram in /metrics")
+    assert "graftserve_dispatch_latency_seconds_count" in text
+    assert "graftserve_process_peak_live_bytes" in text
+    assert "graftserve_footprint_bytes{" in text, (
+        "no footprint gauge in /metrics")
+
+
+def main() -> int:
+    out_base = sys.argv[1] if len(sys.argv) > 1 else "/tmp/sr_gauge_smoke"
+    scenarios = [
+        ("leak-anomaly-bundle", scenario_leak_anomaly_bundle),
+        ("aot-footprint-roundtrip", scenario_aot_footprint_roundtrip),
+        ("proactive-degrade", scenario_proactive_degrade),
+        ("metrics-scrape", scenario_metrics_scrape),
+    ]
+    for name, fn in scenarios:
+        try:
+            fn(out_base)
+        except Exception as e:  # noqa: BLE001 - report and fail the job
+            print(f"FAIL [{name}]: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"OK   [{name}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
