@@ -11,8 +11,23 @@ semantically-degraded or restructured copies of the interpreter:
                with an inner per-class switch
   signmerge  — {+,-} merged into ONE branch via a sign bit packed in the
                instruction word (val = a + sgn*b, one FMA)
+  muldiv     — signmerge PLUS {*,/} merged via reciprocal-select (a
+               div bit picks b vs 1/b, then one multiply). NOTE: a/b
+               vs a*(1/b) differ in the last bit (two roundings), so
+               this merge trades bit-exactness for a branch — checked
+               here with allclose, not equality.
+  opgroup    — muldiv PLUS all unary transcendentals grouped into ONE
+               branch: compute every unary fn of the operand and
+               select by a 3-bit unary index. Trades dispatch branches
+               for unconditional transcendental FLOPs.
   nounroll   — no 2x pair unroll
   tb16/tb32  — tree_block 16/32 (X-copy + grid fixed costs amortized)
+
+On a non-TPU backend the pallas kernels run in interpret mode: timings
+are then meaningless, but the variant-vs-base loss checks still run —
+that is how the muldiv/opgroup merges are validated on CPU CI while
+the dispatch-cost verdict comes from the round-3 branch-cost model
+(profiling/RESULTS.md).
 
 Round-7 graftstage rows (docs/PRECISION.md) — these run the SHIPPED
 fused_loss_program, not the legacy A/B copy above:
@@ -140,6 +155,47 @@ def _make_kernel(operators, loss_fn, tree_block, nfeat, cmax, variant):
                         lambda: binary_fns[3](read(i1), read(i2)),
                     ] + [lambda f=f: f(read(i1)) for f in unary_fns]
                     val = jax.lax.switch(o2, branches)
+                elif variant in ("muldiv", "opgroup"):
+                    # codes: 0 id, 1 addsub (sign bit 30), 2 muldiv
+                    # (div bit 29 -> reciprocal-select), then unary —
+                    # individually for "muldiv", as ONE grouped branch
+                    # selected by a 3-bit unary index (bits 26-28) for
+                    # "opgroup"
+                    s = (w_ >> 30) & 1
+                    dflag = (w_ >> 29) & 1
+                    sgn = (1 - 2 * s).astype(bdt)
+                    if variant == "muldiv":
+                        o2 = (w_ >> 24) & 0x1F
+                        uidx = 0
+                    else:
+                        o2 = (w_ >> 24) & 0x3
+                        uidx = (w_ >> 26) & 0x7
+
+                    def _muldiv():
+                        b_ = read(i2)
+                        b_ = jnp.where(
+                            dflag > 0,
+                            jnp.asarray(1.0, bdt) / b_, b_)
+                        return read(i1) * b_
+
+                    branches = [
+                        lambda: read(i1),
+                        lambda: read(i1) + sgn * read(i2),
+                        _muldiv,
+                    ]
+                    if variant == "opgroup" and unary_fns:
+                        def _ungrouped():
+                            a_ = read(i1)
+                            val = unary_fns[0](a_)
+                            for u, f in enumerate(unary_fns[1:], 1):
+                                val = jnp.where(uidx == u, f(a_), val)
+                            return val
+
+                        branches.append(_ungrouped)
+                    else:
+                        branches += [lambda f=f: f(read(i1))
+                                     for f in unary_fns]
+                    val = jax.lax.switch(o2, branches)
                 else:
                     val = jax.lax.switch(
                         o, _merged_branches(operators, read, i1, i2))
@@ -185,9 +241,10 @@ def _make_kernel(operators, loss_fn, tree_block, nfeat, cmax, variant):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "nfeatures", "operators", "loss_fn", "tree_block", "variant"))
+    "nfeatures", "operators", "loss_fn", "tree_block", "variant",
+    "interpret"))
 def loss_variant(prog, X, y, nfeatures, operators, loss_fn,
-                 tree_block=8, variant="base"):
+                 tree_block=8, variant="base", interpret=False):
     T, L = prog.code.shape
     CMAX = prog.cmax
     F, n = X.shape
@@ -215,6 +272,23 @@ def loss_variant(prog, X, y, nfeatures, operators, loss_fn,
                        jnp.where(o <= 4, o - 1, o - 1))
         instr_w = ((is_sub.astype(jnp.int32) << 30) | (o2 << 24)
                    | (prog.src1 << 12) | prog.src2)
+    elif variant in ("muldiv", "opgroup"):
+        # remap codes: 1:+ 2:- -> 1 (+ sign bit 30); 3:* 4:/ -> 2
+        # (+ div bit 29); unary 5.. -> 3.. individually ("muldiv") or
+        # all -> 3 with the unary index in bits 26-28 ("opgroup")
+        o = prog.code
+        is_sub = (o == 2).astype(jnp.int32)
+        is_div = (o == 4).astype(jnp.int32)
+        if variant == "muldiv":
+            o2 = jnp.where(o <= 2, jnp.minimum(o, 1),
+                           jnp.where(o <= 4, 2, o - 2))
+            uidx = jnp.zeros_like(o)
+        else:
+            o2 = jnp.where(o <= 2, jnp.minimum(o, 1),
+                           jnp.where(o <= 4, 2, 3))
+            uidx = jnp.maximum(o - 5, 0)
+        instr_w = ((is_sub << 30) | (is_div << 29) | (uidx << 26)
+                   | (o2 << 24) | (prog.src1 << 12) | prog.src2)
     instr = pad_t(instr_w)
     nsteps = pad_t(prog.nsteps.reshape(-1, 1), fill=1)
     nconst = pad_t(prog.nconst.reshape(-1, 1))
@@ -259,6 +333,7 @@ def loss_variant(prog, X, y, nfeatures, operators, loss_fn,
             jax.ShapeDtypeStruct((T_pad, 1), jnp.int32),
         ],
         scratch_shapes=[pltpu.VMEM((BASE + instr.shape[-1], TILE), buf_dtype)],
+        interpret=interpret,
     )(instr, nsteps, nconst, cvals, ok, Xp, yp, wp, maskp)
     return loss_sum[:T, 0], valid[:T, 0]
 
@@ -293,7 +368,8 @@ def main():
     T = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
     S = int(os.environ.get("STEPS", "8"))
     which = sys.argv[2:] or ["base", "noswitch", "novmask", "cond2",
-                             "signmerge", "nounroll", "tb16", "tb32",
+                             "signmerge", "muldiv", "opgroup",
+                             "nounroll", "tb16", "tb32",
                              "prod", "prodbf16", "screen"]
 
     options, ds, engine = make_bench_problem()
@@ -307,6 +383,7 @@ def main():
     steps = np.asarray(prog.nsteps)
     print(f"T={T} steps: mean {steps.mean():.2f} max {steps.max()}")
 
+    interp_all = jax.default_backend() != "tpu"
     base_loss = None
     for v in which:
         tb = 8
@@ -344,10 +421,10 @@ def main():
                     p, cvals=p.cvals + eps * 1e-30), loss
         else:
             @jax.jit
-            def step_fn(p, tb=tb, vv=vv):
+            def step_fn(p, tb=tb, vv=vv, interp=interp_all):
                 loss, valid = loss_variant(
                     p, X, y, F, cfg.operators, options.elementwise_loss,
-                    tree_block=tb, variant=vv)
+                    tree_block=tb, variant=vv, interpret=interp)
                 eps = jnp.nanmin(
                     jnp.where(jnp.isfinite(loss), loss, jnp.inf))
                 return dataclasses.replace(
@@ -363,14 +440,21 @@ def main():
         jax.block_until_ready(loss)
         dt = (time.perf_counter() - t0) / N
         ok = ""
-        if vv in ("base", "cond2", "signmerge", "nounroll", "cvec",
-                  "custatic") or v.startswith("tb"):
+        if vv in ("base", "cond2", "signmerge", "muldiv", "opgroup",
+                  "nounroll", "cvec", "custatic") or v.startswith("tb"):
             if base_loss is None and v == "base":
                 base_loss = np.asarray(loss)
             elif base_loss is not None:
+                # reciprocal-select (a/b -> a*(1/b)) is a last-bit
+                # rewrite that exp/log chains amplify to ~1e-3 relative
+                # on rare trees — the merged variants get a loose band
+                # and an honest label, everything else stays tight
+                rtol = 1e-3 if vv in ("muldiv", "opgroup") else 1e-6
                 match = np.allclose(np.asarray(loss), base_loss,
-                                    rtol=1e-6, equal_nan=True)
-                ok = "  loss==base" if match else "  LOSS MISMATCH"
+                                    rtol=rtol, equal_nan=True)
+                tag = ("loss~=base@1e-3"
+                       if vv in ("muldiv", "opgroup") else "loss==base")
+                ok = f"  {tag}" if match else "  LOSS MISMATCH"
         print(f"{v:10s} {dt*1e3:8.3f} ms/launch  {T/dt:>10.0f} trees/s"
               f"  {dt/T/steps.mean()*1e9:6.1f} ns/step{ok}")
 

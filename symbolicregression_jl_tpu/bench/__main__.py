@@ -142,13 +142,19 @@ def cmd_gate(args) -> int:
 
 
 def cmd_load(args) -> int:
-    from .load import run_load
+    from .load import run_compare, run_load
 
-    report = run_load(
-        args.root, requests=args.requests, workers=args.workers,
+    kw = dict(
+        requests=args.requests, workers=args.workers,
         capacity=args.capacity, rows=args.rows,
         niterations=args.niterations, timeout_s=args.timeout,
     )
+    if args.compare:
+        report = run_compare(args.root, row_step=args.row_step, **kw)
+        _write_json(args.out, report)
+        return 0 if report["ok"] else 1
+    report = run_load(args.root, packed=args.packed,
+                      row_step=args.row_step, **kw)
     _write_json(args.out, report)
     if not report["ok"]:
         print(f"load: {report['failed']} failed / "
@@ -207,6 +213,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--rows", type=int, default=160)
     p.add_argument("--niterations", type=int, default=1)
     p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--packed", action="store_true",
+                   help="graftpack multi-tenant packing: pad requests "
+                        "to their shape bucket and launch same-bucket "
+                        "cohorts together (adds occupancy/coalesce "
+                        "metrics to the report)")
+    p.add_argument("--row-step", type=int, default=0,
+                   help="near-miss row mix: request i gets rows + "
+                        "(i %% 4) * row_step rows (same shape bucket)")
+    p.add_argument("--compare", action="store_true",
+                   help="run the storm timeshared AND packed at a "
+                        "near-miss row mix; report the wall ratio")
     p.add_argument("--out", default=None, help="report JSON path")
     p.set_defaults(fn=cmd_load)
 

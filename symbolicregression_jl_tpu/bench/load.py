@@ -22,9 +22,11 @@ import shutil
 import time
 from typing import Any, Dict, List, Optional
 
-__all__ = ["LOAD_SCHEMA", "percentile", "run_load"]
+__all__ = ["LOAD_SCHEMA", "COMPARE_SCHEMA", "percentile", "run_load",
+           "run_compare"]
 
 LOAD_SCHEMA = "graftbench.load.v1"
+COMPARE_SCHEMA = "graftbench.load_compare.v1"
 
 
 def percentile(samples: List[float], q: float) -> Optional[float]:
@@ -63,6 +65,8 @@ def run_load(
     niterations: int = 1,
     poll_interval_s: float = 0.02,
     timeout_s: float = 600.0,
+    packed: bool = False,
+    row_step: int = 0,
     log=print,
 ) -> Dict[str, Any]:
     """Run the storm; returns the schema-versioned load report.
@@ -70,23 +74,42 @@ def run_load(
     All requests share one shape bucket (same ``rows``), so repeats
     after the first SHOULD hit the executable cache — the hit rate is
     the serve-scaling headline (docs/SERVING.md pins >=90% on repeats).
+
+    ``packed=True`` turns on graftpack multi-tenant packing (default
+    PackPolicy) and adds the ``pack`` metrics section: per-launch
+    occupancy, coalesce wait p50/p99, and — via the graftledger rollup
+    already reported — the per-tenant device-seconds fairness spread.
+    ``row_step`` varies request row counts (rows + (i % 4) * row_step)
+    WITHIN the same shape bucket: the near-miss mix that padding
+    collapses onto one traced executable and that timesharing retraces
+    per distinct shape — set it on both sides of a packed-vs-timeshared
+    comparison (:func:`run_compare`).
     """
     import numpy as np
 
     from ..ledger.rollup import load_rollup
-    from ..serve.admission import ServerSaturated
+    from ..pack import PackPolicy
+    from ..serve.admission import ServerSaturated, shape_bucket
     from ..serve.server import SearchServer
     from ..telemetry.report import summarize
     from ..telemetry.schema import load_events
 
     if os.path.isdir(root):
         shutil.rmtree(root)  # a stale journal would replay old requests
+    row_counts = [rows + (i % 4) * max(int(row_step), 0)
+                  for i in range(requests)]
+    if len({shape_bucket(r, 2) for r in row_counts}) > 1:
+        raise ValueError(
+            f"row_step={row_step} pushes the near-miss mix across shape "
+            f"buckets; the storm must stay same-bucket")
     rng = np.random.default_rng(0)
-    X = rng.uniform(-2.0, 2.0, (rows, 2)).astype(np.float32)
-    y = (X[:, 0] * 2.0 + X[:, 1]).astype(np.float32)
+    Xfull = rng.uniform(-2.0, 2.0, (max(row_counts), 2)).astype(np.float32)
+    yfull = (Xfull[:, 0] * 2.0 + Xfull[:, 1]).astype(np.float32)
     opts = _storm_options()
 
-    server = SearchServer(root, capacity=capacity, workers=workers)
+    server = SearchServer(
+        root, capacity=capacity, workers=workers,
+        pack=PackPolicy() if packed else None)
     submitted: List[str] = []
     rejects = 0
     poll_lat: List[float] = []
@@ -99,11 +122,12 @@ def run_load(
         # storm keeps the queue pinned at capacity for its whole span
         deadline0 = time.monotonic() + timeout_s
         for i in range(requests):
+            n_i = row_counts[i]
             while True:
                 try:
                     rid = server.submit(
-                        X, y, options=opts, niterations=niterations,
-                        seed=i,
+                        Xfull[:n_i], yfull[:n_i], options=opts,
+                        niterations=niterations, seed=i,
                     )
                     submitted.append(rid)
                     break
@@ -139,11 +163,54 @@ def run_load(
             if s.get("sample_rows") is not None]
 
     cache_hit_rate = None
+    pack_metrics: Optional[Dict[str, Any]] = None
     serve_stream = os.path.join(root, "serve_telemetry.jsonl")
     if os.path.exists(serve_stream):
-        summary = summarize(load_events(serve_stream))
+        events = load_events(serve_stream)
+        summary = summarize(events)
         cache_hit_rate = (summary.get("serve", {})
                           .get("cache", {}).get("hit_rate"))
+        if packed:
+            # graftpack occupancy + coalesce waits from the serve
+            # stream: pack_launch carries per-tenant coalesce waits,
+            # pack_join the late joiners', pack_done the per-round
+            # occupancy record (pack/cohort.py)
+            waits: List[float] = []
+            occs: List[float] = []
+            launches = multi = tenants = 0
+            for e in events:
+                if e.get("event") != "serve":
+                    continue
+                det = e.get("detail") or {}
+                if e.get("kind") == "pack_launch":
+                    launches += 1
+                    members = det.get("tenants") or []
+                    tenants += len(members)
+                    if len(members) > 1:
+                        multi += 1
+                    waits.extend(
+                        float(w) for w in
+                        (det.get("coalesce_wait_s") or {}).values())
+                elif e.get("kind") == "pack_join":
+                    tenants += 1
+                    if det.get("coalesce_wait_s") is not None:
+                        waits.append(float(det["coalesce_wait_s"]))
+                elif e.get("kind") == "pack_done":
+                    if isinstance(det.get("occupancy"), (int, float)):
+                        occs.append(float(det["occupancy"]))
+            pack_metrics = {
+                "launches": launches,
+                "multi_tenant_launches": multi,
+                "tenants": tenants,
+                "occupancy_mean": (round(sum(occs) / len(occs), 4)
+                                   if occs else None),
+                "coalesce_wait_s": {
+                    "samples": len(waits),
+                    "p50": percentile(waits, 50),
+                    "p99": percentile(waits, 99),
+                    "max": max(waits) if waits else None,
+                },
+            }
 
     # per-tenant cost attribution: the server's graftledger rollup
     # (written on every request completion) gives each request's
@@ -176,6 +243,7 @@ def run_load(
             "requests": requests, "workers": workers,
             "capacity": capacity, "rows": rows,
             "niterations": niterations,
+            "packed": packed, "row_step": row_step,
         },
         "submitted": len(submitted),
         "rejected": rejects,
@@ -195,6 +263,7 @@ def run_load(
             "max": max(poll_lat) if poll_lat else None,
         },
         "cache_hit_rate": cache_hit_rate,
+        "pack": pack_metrics,
         "ledger": ledger,
         "serve_telemetry": serve_stream,
     }
@@ -210,9 +279,51 @@ def run_load(
             f"{ledger['total_device_s']:.3f} device-s total, "
             f"fairness spread (max/min device-s) "
             f"{'-' if ledger['fairness_spread'] is None else ledger['fairness_spread']}")
+    if pack_metrics is not None:
+        cw = pack_metrics["coalesce_wait_s"]
+        log(f"load: pack {pack_metrics['launches']} launch(es) "
+            f"({pack_metrics['multi_tenant_launches']} multi-tenant, "
+            f"{pack_metrics['tenants']} tenants), "
+            f"occupancy {pack_metrics['occupancy_mean']}, "
+            f"coalesce wait p50 "
+            f"{'-' if cw['p50'] is None else format(cw['p50'], '.3f')}s / "
+            f"p99 {'-' if cw['p99'] is None else format(cw['p99'], '.3f')}s")
     # a storm where admission wedged and some requests were NEVER
     # accepted (the retry loop ran out its deadline) must fail too —
     # submitted==0 with zero failures is not a healthy server
     report["ok"] = (not failed and not unfinished
                     and len(submitted) == requests)
     return report
+
+
+def run_compare(root: str, *, log=print, **kw) -> Dict[str, Any]:
+    """Timeshared-vs-packed A/B at identical storm parameters.
+
+    Runs the same near-miss same-bucket storm twice — once on the
+    timeshared path (each distinct row count retraces the shared
+    engine's jitted programs), once packed (every request padded to the
+    bucket, one trace, cohorts of concurrent tenants) — and reports the
+    wall-clock ratio. ISSUE-20 acceptance pins packed <= 0.6x
+    timeshared on a 4x oversubscribed same-bucket CPU storm.
+    """
+    kw.setdefault("row_step", 8)
+    ts = run_load(os.path.join(root, "timeshared"),
+                  packed=False, log=log, **kw)
+    pk = run_load(os.path.join(root, "packed"),
+                  packed=True, log=log, **kw)
+    speedup = (round(ts["wall_s"] / pk["wall_s"], 3)
+               if pk["wall_s"] else None)
+    log(f"compare: timeshared {ts['wall_s']}s vs packed {pk['wall_s']}s "
+        f"-> packed/timeshared = "
+        f"{'-' if not speedup else format(pk['wall_s'] / ts['wall_s'], '.2f')}x"
+        f" (speedup {speedup}x)")
+    return {
+        "schema": COMPARE_SCHEMA,
+        "t": time.time(),
+        "timeshared": ts,
+        "packed": pk,
+        "wall_ratio_packed_over_timeshared": (
+            round(pk["wall_s"] / ts["wall_s"], 3) if ts["wall_s"] else None),
+        "speedup": speedup,
+        "ok": bool(ts["ok"] and pk["ok"]),
+    }

@@ -49,6 +49,8 @@ import numpy as np
 
 from ..ledger.context import TraceContext, mint_trace
 from ..ledger.rollup import load_rollup, write_rollup
+from ..pack import (PackedCohort, PackPolicy, pack_group_key, packable,
+                    pad_to_bucket, slot_cap)
 from ..shield.faults import active_serve_injector
 from ..shield.watchdog import Watchdog, WatchdogTimeout
 from .admission import AdmissionController, ServerSaturated, shape_bucket
@@ -94,6 +96,16 @@ class SearchRequest:
     sample_rows: Optional[int] = None
     bucket: Tuple[int, int, int] = (0, 0, 0)
     index: int = 0  # k-th accepted request of this root, 1-based
+    # graftpack padded-bucket provenance (docs/SERVING.md "Packed
+    # tenancy"): the pow2 row count this request's dataset is padded to
+    # (0 = unpacked path) and how many zero-weight replica rows that
+    # adds AFTER any overload-shed sampling. Journaled effective
+    # configuration, like sample_rows: replay reads these back instead
+    # of re-deriving from the server's current pack setting, so a
+    # killed-and-restarted request pads identically even if the
+    # restarted server's pack policy changed.
+    bucket_rows: int = 0
+    pad_rows: int = 0
     # graftpulse: arm a profiler-capture window for this request's
     # search (RuntimeOptions.pulse_trace_on); journaled so a replayed
     # request still honors it
@@ -116,6 +128,8 @@ class SearchRequest:
             "sample_rows": self.sample_rows,
             "bucket": list(self.bucket),
             "index": int(self.index),
+            "bucket_rows": int(self.bucket_rows),
+            "pad_rows": int(self.pad_rows),
             "pulse_trace": bool(self.pulse_trace),
             "trace": self.trace.to_dict() if self.trace else None,
         }
@@ -134,6 +148,8 @@ class SearchRequest:
             sample_rows=d.get("sample_rows"),
             bucket=tuple(d.get("bucket") or (0, 0, 0)),
             index=int(d.get("index", 0)),
+            bucket_rows=int(d.get("bucket_rows", 0)),
+            pad_rows=int(d.get("pad_rows", 0)),
             pulse_trace=bool(d.get("pulse_trace", False)),
             # pre-graftledger journals carry no trace: re-mint from the
             # same content the original submit would have hashed, so
@@ -193,6 +209,8 @@ class _RequestRecord:
             "priority": self.request.priority,
             "bucket": list(self.request.bucket),
             "sample_rows": self.request.sample_rows,
+            "bucket_rows": self.request.bucket_rows,
+            "pad_rows": self.request.pad_rows,
             "result": self.result,
             "error": self.error,
             "cancel_reason": self.cancel_reason,
@@ -203,13 +221,21 @@ class _RequestRecord:
 class _InjectorProbe:
     """RuntimeOptions.logger shim: a per-iteration hook inside a
     running request's search without any api/search.py surface. Serves
-    two consumers: the serve fault injector (cancel-mid-iteration
-    scenario) and the /metrics per-request progress gauges (iteration,
-    evals, evals/s of every RUNNING request, live)."""
+    three consumers: the serve fault injector (cancel-mid-iteration
+    scenario), the /metrics per-request progress gauges (iteration,
+    evals, evals/s of every RUNNING request, live), and — when the
+    request runs inside a graftpack cohort — the lockstep barrier
+    (pack/cohort.py), which keys the tenants' iteration boundaries
+    together. The barrier call comes LAST: a cancel decided this
+    iteration must not wait a full round to be observed."""
 
-    def __init__(self, server: "SearchServer", rec: _RequestRecord) -> None:
+    def __init__(self, server: "SearchServer", rec: _RequestRecord,
+                 cohort: Optional[PackedCohort] = None,
+                 slot: Optional[int] = None) -> None:
         self.server = server
         self.rec = rec
+        self.cohort = cohort
+        self.slot = slot
 
     def log_iteration(self, *, iteration, num_evals=0.0, elapsed=0.0,
                       **_kw) -> None:
@@ -225,6 +251,8 @@ class _InjectorProbe:
                 self.rec.request.index, it,
                 self.rec.request.request_id):
             self.rec.cancel("cancelled")
+        if self.cohort is not None and self.slot is not None:
+            self.cohort.arrive(self.slot)
 
 
 class _RequestCacheView:
@@ -283,6 +311,7 @@ class SearchServer:
         telemetry: bool = True,
         metrics_port: Optional[int] = None,
         debug_checks: bool = False,
+        pack=None,
     ) -> None:
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
@@ -308,6 +337,30 @@ class SearchServer:
         )
         self.cache = cache or ExecutableCache(
             on_event=self._on_cache_event)
+        # graftpack multi-tenant packing (docs/SERVING.md "Packed
+        # tenancy"): OFF by default. ``pack=True`` enables the default
+        # PackPolicy; a PackPolicy instance sets the knobs. When on,
+        # packable requests are padded to their admission bucket at
+        # submit (journaled provenance) and same-group queued requests
+        # launch together as one lockstep cohort sharing a compiled
+        # engine, instead of timesharing the worker.
+        if pack is True:
+            self.pack: Optional[PackPolicy] = PackPolicy()
+        elif pack:
+            self.pack = pack
+        else:
+            self.pack = None
+        # pack counters for /metrics; mutated under self._lock
+        self._pack_stats = {
+            "launches": 0, "multi_tenant_launches": 0, "tenants": 0,
+            "peak_tenants": 0, "occupancy_sum": 0.0, "occupancy_n": 0,
+        }
+        # pack groups whose shared programs have been traced at least
+        # once (a tenant completed an iteration): cold groups stage
+        # their first launch so ONE tenant pays the trace/compile
+        # instead of every tenant re-tracing concurrently (the engine
+        # cache dedupes Engine objects, not jit traces in flight)
+        self._pack_warm: set = set()
         self.workers = int(workers)
         self.hang_grace_s = float(hang_grace_s)
         self._lock = threading.RLock()
@@ -515,6 +568,20 @@ class SearchServer:
                         if rid not in self._records:
                             break
                 self._accepted += 1
+                # graftpack padding provenance, decided AT ADMISSION and
+                # journaled: effective rows (post-shed) padded up to the
+                # bucket's pow2 row count. Computed here, not at run
+                # time, so replay pads identically regardless of the
+                # replaying server's pack setting.
+                bucket_rows = pad_rows = 0
+                if self.pack is not None and packable(opts):
+                    eff_rows = (
+                        decision.sample_rows
+                        if decision.sample_rows is not None
+                        and decision.sample_rows < X.shape[0]
+                        else X.shape[0])
+                    bucket_rows = int(decision.bucket[0])
+                    pad_rows = max(bucket_rows - int(eff_rows), 0)
                 req = SearchRequest(
                     request_id=rid, X=X, y=y,
                     niterations=int(niterations), seed=int(seed),
@@ -522,6 +589,7 @@ class SearchServer:
                     deadline_s=deadline_s,
                     sample_rows=decision.sample_rows,
                     bucket=decision.bucket, index=self._accepted,
+                    bucket_rows=bucket_rows, pad_rows=pad_rows,
                     pulse_trace=bool(pulse_trace),
                     # graftledger root span: minted from request content
                     # (never the root path), journaled with the submit
@@ -563,6 +631,9 @@ class SearchServer:
             sample_rows=decision.sample_rows,
             level=decision.level, queue_depth=self.admission.depth,
             memory=decision.memory,
+            # graftpack padding provenance: bucket_rows=0 means the
+            # unpacked path; `report summarize_requests` audits these
+            bucket_rows=req.bucket_rows, pad_rows=req.pad_rows,
         )
         with self._lock:
             rec.journaled = True
@@ -646,6 +717,22 @@ class SearchServer:
                   "Executable-cache misses")
         p.gauge("cache_hit_rate", stats["hit_rate"] or 0.0,
                 "hits / (hits + misses); 0 before any lookup")
+        if self.pack is not None:
+            with self._lock:
+                ps = dict(self._pack_stats)
+            p.counter("pack_launches_total", ps["launches"],
+                      "Packed cohort launches")
+            p.counter("pack_multi_tenant_launches_total",
+                      ps["multi_tenant_launches"],
+                      "Cohort launches holding more than one tenant")
+            p.counter("pack_tenants_total", ps["tenants"],
+                      "Tenant searches run inside packed cohorts")
+            p.gauge("pack_peak_tenants", ps["peak_tenants"],
+                    "Largest tenant count of any single launch")
+            p.gauge("pack_mean_occupancy",
+                    (ps["occupancy_sum"] / ps["occupancy_n"]
+                     if ps["occupancy_n"] else 0.0),
+                    "Mean per-round tenant occupancy across launches")
         with self._lock:
             by_state: Dict[str, int] = {}
             running = []
@@ -830,11 +917,204 @@ class SearchServer:
                 rec.state = "running"
                 rec.started_t = time.time()
             try:
-                self._run_request(rec)
+                if self.pack is not None and rec.request.bucket_rows > 0:
+                    # packed path: this worker becomes the cohort
+                    # manager — it claims co-queued same-group requests
+                    # and launches them together (one shared compiled
+                    # program, lockstep iterations)
+                    self._run_packed_cohort(rec)
+                else:
+                    self._run_request(rec)
             except Exception as e:  # noqa: BLE001 - fail the request
                 self._finish(rec, "failed",
                              error=f"{type(e).__name__}: {e}")
             with self._cond:
+                self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # graftpack: packed-cohort manager (docs/SERVING.md "Packed tenancy")
+    # ------------------------------------------------------------------
+    def _claim_pack_peers(self, gkey: str,
+                          budget: int) -> List[_RequestRecord]:
+        """Claim up to ``budget`` queued requests of the same pack group
+        (same bucket + same options kwargs). Claimed records flip to
+        "running" under the lock; their heap tuples are removed lazily,
+        exactly like a queued cancel (the worker pop skips non-queued
+        states)."""
+        claimed: List[_RequestRecord] = []
+        with self._lock:
+            for _, _, rid in sorted(self._queue):
+                if len(claimed) >= budget:
+                    break
+                r = self._records.get(rid)
+                if r is None or r.state != "queued":
+                    continue
+                rq = r.request
+                if rq.bucket_rows <= 0:
+                    continue
+                if pack_group_key(rq.bucket, rq.options_kwargs) != gkey:
+                    continue
+                r.state = "running"
+                r.started_t = time.time()
+                claimed.append(r)
+        return claimed
+
+    def _run_pack_tenant(self, rec: _RequestRecord,
+                         cohort: PackedCohort, slot: int) -> None:
+        """One tenant of a packed launch: the unchanged per-request run
+        (journal start/done, checkpoints, ledger, telemetry all intact),
+        plus cohort membership for the iteration barrier. Always peels
+        the slot off, whatever the outcome — a leaked slot would stall
+        the peers' barrier until its timeout."""
+        try:
+            try:
+                self._run_request(rec, cohort=cohort, slot=slot)
+            except Exception as e:  # noqa: BLE001 - fail the request
+                self._finish(rec, "failed",
+                             error=f"{type(e).__name__}: {e}")
+        finally:
+            cohort.leave(slot)
+            self.log.serve(
+                "pack_peel", rec.request.request_id,
+                trace=rec.request.trace, state=rec.state,
+                iterations=(rec.progress or {}).get("iteration"),
+            )
+            with self._cond:
+                self._cond.notify_all()
+
+    def _run_packed_cohort(self, lead: _RequestRecord) -> None:
+        """Cohort manager, run on the worker thread that popped the
+        lead request: coalesce the burst, launch every tenant on its
+        own thread, then admit late joiners at iteration boundaries
+        until the group drains. Tenant threads are owned by this
+        manager (the worker does not return until they exit), so
+        stop()/preemption semantics are unchanged — each tenant's
+        stop_hook fires exactly as on the unpacked path."""
+        req = lead.request
+        gkey = pack_group_key(req.bucket, req.options_kwargs)
+        # graftgauge bin capacity: the per-bucket byte prediction from
+        # the headroom model bounds how many tenants one launch holds.
+        # Advisory contract carries over: no data -> policy cap, and
+        # the floor is always the lead tenant.
+        advice = None
+        if self.admission.headroom is not None:
+            try:
+                advice = self.admission.headroom.advise(
+                    bucket=req.bucket,
+                    limit_bytes=self.admission.memory_limit_bytes)
+            except Exception:  # noqa: BLE001 - advisory is best-effort
+                advice = None
+        cap = slot_cap(self.pack, advice)
+        cohort = PackedCohort(
+            gkey, slot_cap=cap,
+            barrier_timeout_s=self.pack.barrier_timeout_s)
+        # coalesce window (no locks held): let the rest of a burst land
+        # before the first launch so it starts at high occupancy
+        if self.pack.coalesce_window_s > 0 and not self._stopping:
+            time.sleep(self.pack.coalesce_window_s)
+        members = [(lead, cohort.join(req.request_id))]
+        for r in self._claim_pack_peers(gkey, cap - 1):
+            slot = cohort.join(r.request.request_id)
+            if slot is None:  # cannot happen while only we add; belt
+                self._requeue_claimed(r)
+                continue
+            members.append((r, slot))
+        launch_t = time.time()
+        self.log.serve(
+            "pack_launch", req.request_id, trace=req.trace,
+            bucket=list(req.bucket), slot_cap=cap,
+            tenants=[r.request.request_id for r, _ in members],
+            coalesce_wait_s={
+                r.request.request_id: round(launch_t - r.submitted_t, 6)
+                for r, _ in members},
+            memory=advice,
+        )
+        with self._lock:
+            st = self._pack_stats
+            st["launches"] += 1
+            st["tenants"] += len(members)
+            if len(members) > 1:
+                st["multi_tenant_launches"] += 1
+            st["peak_tenants"] = max(st["peak_tenants"], len(members))
+        threads: List[threading.Thread] = []
+
+        def spawn(r: _RequestRecord, slot: int) -> None:
+            t = threading.Thread(
+                target=self._run_pack_tenant, args=(r, cohort, slot),
+                name=f"graftpack-{r.request.request_id}", daemon=True)
+            t.start()
+            threads.append(t)
+
+        with self._lock:
+            warm = gkey in self._pack_warm
+        spawn(*members[0])
+        if not warm and len(members) > 1:
+            # cold group: the lead's FIRST iteration traces+compiles
+            # the shared device programs; peers spawned now would each
+            # re-trace the same programs concurrently (jit dedupes
+            # executables, not traces in flight) and the pack's
+            # one-compile win would become N compiles. Hold the peers
+            # until the lead's first iteration boundary — the probe
+            # sets rec.progress BEFORE arriving at the barrier, and
+            # the lead then simply waits at that barrier until the
+            # warmed peers catch up (scheduling-only, always safe).
+            lead_t = threads[0]
+            while (lead_t.is_alive() and lead.progress is None
+                   and not self._stopping
+                   and not self._preempt_requested()):
+                lead_t.join(timeout=self.pack.join_poll_s)
+            if lead.progress is not None:
+                with self._lock:
+                    self._pack_warm.add(gkey)
+        else:
+            with self._lock:
+                self._pack_warm.add(gkey)
+        for r, slot in members[1:]:
+            spawn(r, slot)
+        # late-join loop: free slots (initial headroom or peeled
+        # tenants) admit queued same-group requests at iteration
+        # boundaries while the cohort is still running
+        while any(t.is_alive() for t in threads):
+            if not self._stopping and not self._preempt_requested():
+                budget = cap - cohort.size()
+                if budget > 0:
+                    for r in self._claim_pack_peers(gkey, budget):
+                        slot = cohort.join(r.request.request_id)
+                        if slot is None:
+                            self._requeue_claimed(r)
+                            continue
+                        self.log.serve(
+                            "pack_join", r.request.request_id,
+                            trace=r.request.trace,
+                            bucket=list(r.request.bucket),
+                            coalesce_wait_s=round(
+                                time.time() - r.submitted_t, 6),
+                        )
+                        with self._lock:
+                            self._pack_stats["tenants"] += 1
+                        spawn(r, slot)
+            for t in threads:
+                if t.is_alive():
+                    t.join(timeout=self.pack.join_poll_s)
+                    break
+        occ = cohort.occupancy()
+        self.log.serve("pack_done", req.request_id, trace=req.trace,
+                       bucket=list(req.bucket), **occ)
+        with self._lock:
+            if occ["occupancy"] is not None:
+                self._pack_stats["occupancy_sum"] += occ["occupancy"]
+                self._pack_stats["occupancy_n"] += 1
+
+    def _requeue_claimed(self, rec: _RequestRecord) -> None:
+        """Put a claimed-but-not-launched record back on the queue."""
+        with self._cond:
+            if rec.state == "running":
+                rec.state = "queued"
+                self._qseq += 1
+                heapq.heappush(
+                    self._queue,
+                    (rec.request.priority, self._qseq,
+                     rec.request.request_id))
                 self._cond.notify_all()
 
     # ------------------------------------------------------------------
@@ -911,7 +1191,9 @@ class SearchServer:
         # the source of truth. /metrics and `bench load` read it.
         write_rollup(self.root)
 
-    def _run_request(self, rec: _RequestRecord) -> None:
+    def _run_request(self, rec: _RequestRecord,
+                     cohort: Optional[PackedCohort] = None,
+                     slot: Optional[int] = None) -> None:
         from ..api.search import RuntimeOptions, equation_search
         from ..core.options import Options
 
@@ -947,6 +1229,15 @@ class SearchServer:
             sel = (np.arange(req.sample_rows) * X.shape[0]
                    ) // req.sample_rows
             X, y = X[sel], y[sel]
+        # graftpack shape-bucket padding, driven by the JOURNALED
+        # provenance alone (never by cohort membership or the server's
+        # current pack setting): zero-weight cyclic-replica rows up to
+        # the bucket's pow2 row count, provably inert (pack/padding.py)
+        # — so near-miss shapes share one trace/compile, and a replayed
+        # request pads bit-identically
+        weights = None
+        if req.pad_rows > 0 and req.bucket_rows > X.shape[0]:
+            X, y, weights = pad_to_bucket(X, y, rows=req.bucket_rows)
 
         # deadline budget anchored at the FIRST start attempt — wall
         # clock, because it must survive preemption and process
@@ -979,7 +1270,7 @@ class SearchServer:
             verbosity=0, checkpoint_every_n=1, return_state=True,
             engine_cache=_RequestCacheView(self.cache, req.bucket),
             stop_hook=stop_hook,
-            logger=_InjectorProbe(self, rec), log_every_n=1,
+            logger=_InjectorProbe(self, rec, cohort, slot), log_every_n=1,
             pulse_trace_on=bool(req.pulse_trace),
             # graftledger: the search runs under a child span of the
             # journaled request root — its hub stamps the same trace_id
@@ -1013,8 +1304,8 @@ class SearchServer:
                 # fresh; a journal-replayed run finds the request's
                 # rolling checkpoints and continues bit-identically.
                 state, hof = equation_search(
-                    X, y, options=options, resume="auto",
-                    runtime_options=ropt,
+                    X, y, weights=weights, options=options,
+                    resume="auto", runtime_options=ropt,
                 )
         except WatchdogTimeout:
             self._finish(rec, "cancelled", journal_event="cancel")

@@ -89,7 +89,7 @@ def summarize_requests(events: List[dict]) -> Dict[str, Any]:
             "events": 0, "iterations": 0, "num_evals": None,
             "faults": {}, "serve": {}, "state": None,
             "first_t": None, "last_t": None, "stop_reason": None,
-            "trace_id": None,
+            "trace_id": None, "padding": None,
         })
         if tid is not None and g["trace_id"] is None:
             g["trace_id"] = tid
@@ -112,6 +112,17 @@ def summarize_requests(events: List[dict]) -> Dict[str, Any]:
             g["serve"][kind] = g["serve"].get(kind, 0) + 1
             if kind in _SERVE_LIFECYCLE:
                 g["state"] = kind
+            if kind == "accept":
+                # graftpack padded-bucket provenance from the journaled
+                # accept record: replay/audit reads it back here rather
+                # than re-deriving the padding from shapes
+                det = e.get("detail") or {}
+                if det.get("bucket_rows"):
+                    g["padding"] = {
+                        "bucket_rows": det.get("bucket_rows"),
+                        "pad_rows": det.get("pad_rows"),
+                        "sample_rows": det.get("sample_rows"),
+                    }
     for g in groups.values():
         if g["first_t"] is not None and g["last_t"] is not None:
             g["span_s"] = g["last_t"] - g["first_t"]
@@ -136,7 +147,7 @@ def _summarize_serve(serve: List[dict]) -> Dict[str, Any]:
         d["hits" if e["kind"] == "cache_hit" else "misses"] += 1
     for d in by_bucket.values():
         d["hit_rate"] = _rate(d["hits"], d["hits"] + d["misses"])
-    return {
+    out = {
         "events": len(serve),
         "by_kind": kinds,
         "accepted": kinds.get("accept", 0),
@@ -148,6 +159,36 @@ def _summarize_serve(serve: List[dict]) -> Dict[str, Any]:
             "by_bucket": by_bucket,
         },
     }
+    # graftpack aggregates: launches, multi-tenant launches, mean
+    # occupancy (from pack_done), and how much padding admission added
+    padded = pad_rows_total = tenants = multi = 0
+    occ: List[float] = []
+    for e in serve:
+        det = e.get("detail") or {}
+        if e["kind"] == "accept" and det.get("pad_rows"):
+            padded += 1
+            pad_rows_total += int(det["pad_rows"])
+        elif e["kind"] == "pack_launch":
+            t = det.get("tenants") or []
+            tenants += len(t)
+            if len(t) > 1:
+                multi += 1
+        elif e["kind"] == "pack_join":
+            tenants += 1
+        elif e["kind"] == "pack_done":
+            if isinstance(det.get("occupancy"), (int, float)):
+                occ.append(float(det["occupancy"]))
+    if kinds.get("pack_launch") or padded:
+        out["packing"] = {
+            "launches": kinds.get("pack_launch", 0),
+            "multi_tenant_launches": multi,
+            "tenants": tenants,
+            "padded_accepts": padded,
+            "pad_rows_total": pad_rows_total,
+            "mean_occupancy": (round(sum(occ) / len(occ), 4)
+                               if occ else None),
+        }
+    return out
 
 
 def summarize(events: List[dict]) -> Dict[str, Any]:
@@ -623,6 +664,16 @@ def format_report(summary: Dict[str, Any]) -> str:
             lines.append(
                 "  events: " + ", ".join(f"{k}={v}" for k, v in other.items())
             )
+        pk = sv.get("packing")
+        if pk:
+            lines.append(
+                f"  packing: {pk['launches']} launch(es) "
+                f"({pk['multi_tenant_launches']} multi-tenant, "
+                f"{pk['tenants']} tenant(s))  |  "
+                f"{pk['padded_accepts']} padded accept(s), "
+                f"{pk['pad_rows_total']} pad rows  |  "
+                f"occupancy {pk['mean_occupancy']}"
+            )
     reqs = summary.get("requests")
     if reqs:
         lines.append(
@@ -648,6 +699,10 @@ def format_report(summary: Dict[str, Any]) -> str:
                 )
             if g.get("serve", {}).get("cache_hit"):
                 bits.append("cache-hit")
+            if g.get("padding"):
+                bits.append(
+                    f"padded+{g['padding'].get('pad_rows')}"
+                    f"->{g['padding'].get('bucket_rows')}")
             if g.get("span_s") is not None:
                 bits.append(f"{g['span_s']:.1f}s")
             lines.append(f"  {rid}: " + (", ".join(bits) or "no activity"))

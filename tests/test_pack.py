@@ -1,0 +1,360 @@
+"""graftpack: multi-tenant packing correctness (docs/SERVING.md
+"Packed tenancy").
+
+The packing contract is *co-tenancy independence*: with packing ON,
+every tenant's result is bit-identical to the same request run alone on
+a pack-enabled server (a cohort of one — identical padding, identical
+numerics). That plus the padding-inertness guarantees (pack/padding.py)
+is what lets the scheduler coalesce, late-join, and peel tenants freely
+without any tenant being able to observe its neighbours.
+
+Layers pinned here:
+
+- padding unit semantics (cyclic/edge fills, weights, error cases);
+- kernel-level bit-identity: padded zero-weight replica rows leave the
+  fused kernel's per-tree loss sums and validity bits untouched;
+- pad-content invariance: two different fills produce bit-identical
+  full searches (masking completeness — pad values CANNOT leak in);
+- packed-vs-solo bit-identity at 2 and 4 tenants with mixed
+  niterations (peel-off mid-flight);
+- journaled padding provenance surviving replay (the journal records
+  the *effective* padded request, like overload's sample_rows);
+- preempt-restart-replay of a packed server (slow tier).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import symbolicregression_jl_tpu as sr
+from symbolicregression_jl_tpu.api.search import equation_search
+from symbolicregression_jl_tpu.pack import (PackPolicy, pack_group_key,
+                                            packable, pad_to_bucket,
+                                            slot_cap)
+from symbolicregression_jl_tpu.serve import SearchServer
+from symbolicregression_jl_tpu.serve.server import result_fingerprint
+from symbolicregression_jl_tpu.telemetry.report import summarize
+from symbolicregression_jl_tpu.telemetry.schema import load_events
+
+
+def _problem(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2.0, 2.0, (n, 2)).astype(np.float32)
+    y = (X[:, 0] * 2.0 + X[:, 1] * X[:, 1]).astype(np.float32)
+    return X, y
+
+
+def _options(**kw):
+    base = dict(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        maxsize=8,
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=2,
+        tournament_selection_n=4,
+        optimizer_probability=0.0,
+    )
+    base.update(kw)
+    return base
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_pad_to_bucket_cyclic_and_edge():
+    X, y = _problem(5)
+    Xp, yp, w = pad_to_bucket(X, y, rows=8)
+    assert Xp.shape == (8, 2) and yp.shape == (8,) and w.shape == (8,)
+    assert np.array_equal(Xp[:5], X) and np.array_equal(yp[:5], y)
+    # cyclic: pad row j is real row j % n, bit-for-bit
+    for j, src in enumerate([0, 1, 2]):
+        assert np.array_equal(Xp[5 + j], X[src])
+        assert yp[5 + j] == y[src]
+    assert np.array_equal(w, [1, 1, 1, 1, 1, 0, 0, 0])
+    assert w.dtype == X.dtype
+
+    Xe, ye, we = pad_to_bucket(X, y, rows=8, fill="edge")
+    assert all(np.array_equal(Xe[5 + j], X[2]) for j in range(3))
+    assert np.array_equal(we, w)
+
+    # rows == n: copies, all-ones weights
+    Xs, ys, ws = pad_to_bucket(X, y, rows=5)
+    assert np.array_equal(Xs, X) and np.all(ws == 1.0)
+
+    with pytest.raises(ValueError):
+        pad_to_bucket(X, y, rows=3)
+    with pytest.raises(ValueError):
+        pad_to_bucket(X[:0], y[:0], rows=4)
+    with pytest.raises(ValueError):
+        pad_to_bucket(X, y, rows=8, fill="zeros")
+
+
+def test_scheduler_grouping_and_capacity():
+    assert packable(None) and packable({}) and packable({"maxsize": 8})
+    assert not packable({"batching": True})
+
+    k1 = pack_group_key((256, 2, 1), {"a": 1, "b": 2})
+    k2 = pack_group_key((256, 2, 1), {"b": 2, "a": 1})
+    assert k1 == k2  # canonical: insertion order must not matter
+    assert k1 != pack_group_key((512, 2, 1), {"a": 1, "b": 2})
+    assert k1 != pack_group_key((256, 2, 1), {"a": 1})
+
+    pol = PackPolicy(max_tenants=4)
+    assert slot_cap(pol, None) == 4
+    assert slot_cap(pol, {}) == 4  # advisory absent -> policy cap
+    assert slot_cap(
+        pol, {"predicted_bytes": 100, "headroom_bytes": 250}) == 3
+    assert slot_cap(
+        pol, {"predicted_bytes": 100, "headroom_bytes": -50}) == 1
+    assert slot_cap(
+        pol, {"predicted_bytes": 1, "headroom_bytes": 10**9}) == 4
+    assert slot_cap(pol, {"predicted_bytes": None}) == 4
+
+
+# ------------------------------------------------- kernel bit-identity
+
+
+def test_padded_rows_leave_kernel_loss_bit_identical():
+    """Zero-weight replica rows must not move the fused kernel's
+    per-tree loss sums by a single bit, nor flip any validity bit —
+    the foundation of the packed-tenancy bit-identity contract."""
+    from symbolicregression_jl_tpu.core.losses import l2_dist_loss
+    from symbolicregression_jl_tpu.evolve.step import (
+        evolve_config_from_options)
+    from symbolicregression_jl_tpu.ops.encoding import encode_population
+    from symbolicregression_jl_tpu.ops.fused_eval import fused_loss
+
+    opts = sr.Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        maxsize=12, save_to_file=False,
+    )
+    cfg = evolve_config_from_options(opts, 2)
+    opset = cfg.operators
+    X, y = _problem(100)
+    Xp, yp, w = pad_to_bucket(X, y, rows=256)
+
+    exprs = [
+        sr.parse_expression("cos(2.13 * x1) + 0.5 * x2", opset),
+        sr.parse_expression("x1 * x2 - exp(x2 / 2.0)", opset),
+        sr.parse_expression("x1 / (x1 - x1)", opset),  # 1/0 -> invalid
+        sr.parse_expression("1.5", opset),
+    ]
+    batch = encode_population(exprs, opts.maxsize, opset)
+    base_l, base_v = fused_loss(
+        batch, X.T, y, None, opset, l2_dist_loss, interpret=True)
+    for fill in ("cyclic", "edge"):
+        Xf, yf, wf = pad_to_bucket(X, y, rows=256, fill=fill)
+        pad_l, pad_v = fused_loss(
+            batch, Xf.T, yf, wf, opset, l2_dist_loss, interpret=True)
+        assert np.array_equal(np.asarray(base_v), np.asarray(pad_v)), fill
+        assert np.array_equal(
+            np.asarray(base_l), np.asarray(pad_l)), fill
+
+
+@pytest.mark.slow
+def test_pad_content_invariance_full_search():
+    """Two different pad fills (cyclic vs edge replicas) must produce a
+    bit-identical full search: if pad VALUES could influence any part
+    of the search — loss, gradients, validity, baselines — the two
+    fills would diverge."""
+    X, y = _problem(100)
+    fps = []
+    for fill in ("cyclic", "edge"):
+        Xp, yp, w = pad_to_bucket(X, y, rows=256, fill=fill)
+        state, _hof = equation_search(
+            Xp, yp, weights=w, options=sr.Options(**_options(),
+                                                  save_to_file=False),
+            niterations=2, seed=7, verbosity=0, return_state=True)
+        fps.append(result_fingerprint(state))
+    assert fps[0] == fps[1]
+
+
+# --------------------------------------------------- packed-vs-solo
+
+
+def _solo_fingerprint(root, X, y, niter, seed, rid):
+    """The contract's 'solo run': the SAME pack-enabled server config
+    with only this request — a cohort of one, identical padding."""
+    srv = SearchServer(str(root), capacity=4, workers=1,
+                       pack=PackPolicy())
+    srv.submit(X, y, options=_options(), niterations=niter, seed=seed,
+               request_id=rid)
+    srv.start()
+    try:
+        snap = srv.wait(rid, timeout=600)
+    finally:
+        srv.stop(drain=True)
+    assert snap["state"] == "done", snap
+    return snap["result"]["fingerprint"]
+
+
+@pytest.mark.slow
+def test_packed_two_tenants_bit_identical_to_solo(tmp_path):
+    tenants = [  # mixed rows AND niterations: peel-off mid-flight
+        dict(rows=100, niter=2, seed=11),
+        dict(rows=120, niter=3, seed=22),
+    ]
+    datas = [_problem(t["rows"], seed=i) for i, t in enumerate(tenants)]
+
+    root = str(tmp_path / "packed")
+    srv = SearchServer(root, capacity=4, workers=1, pack=PackPolicy())
+    rids = []
+    for i, (t, (X, y)) in enumerate(zip(tenants, datas)):
+        rids.append(srv.submit(
+            X, y, options=_options(), niterations=t["niter"],
+            seed=t["seed"], request_id=f"tenant-{i}"))
+    srv.start()  # both queued before the worker runs -> one cohort
+    try:
+        packed = {rid: srv.wait(rid, timeout=600) for rid in rids}
+    finally:
+        srv.stop(drain=True)
+    assert srv.admission.depth == 0  # no leaked capacity
+    for rid in rids:
+        assert packed[rid]["state"] == "done", packed[rid]
+        assert packed[rid]["pad_rows"] > 0  # really ran padded
+
+    # the launch was genuinely multi-tenant, not two solo runs
+    events = load_events(os.path.join(root, "serve_telemetry.jsonl"))
+    launches = [e for e in events if e.get("kind") == "pack_launch"]
+    assert any(len((e.get("detail") or {}).get("tenants", [])) == 2
+               for e in launches), launches
+    peels = [e for e in events if e.get("kind") == "pack_peel"]
+    assert len(peels) == 2
+
+    for i, (t, (X, y)) in enumerate(zip(tenants, datas)):
+        fp = _solo_fingerprint(tmp_path / f"solo{i}", X, y,
+                               t["niter"], t["seed"], f"tenant-{i}")
+        assert packed[rids[i]]["result"]["fingerprint"] == fp, (
+            f"tenant-{i}: packed result differs from solo run")
+
+
+def test_journal_provenance_roundtrip(tmp_path):
+    """bucket_rows/pad_rows are journaled effective configuration:
+    a replaying server reads them back (never re-derives from its own
+    pack setting) and the report audits them per request."""
+    X, y = _problem(100)
+    root = str(tmp_path / "root")
+    srv = SearchServer(root, capacity=4, workers=0, pack=PackPolicy())
+    rid = srv.submit(X, y, options=_options(), niterations=2, seed=1)
+    snap = srv.poll(rid)
+    assert snap["bucket_rows"] == 256 and snap["pad_rows"] == 156
+
+    # a recovered server WITHOUT pack still carries the provenance —
+    # the padded search is the journaled request's meaning
+    recovered = SearchServer(root, capacity=4, workers=0)
+    rsnap = recovered.poll(rid)
+    assert rsnap["bucket_rows"] == 256 and rsnap["pad_rows"] == 156
+
+    # batching=True requests are not packable: no padding recorded
+    rid2 = srv.submit(X, y, options=_options(batching=True,
+                                             batch_size=32),
+                      niterations=2, seed=2)
+    snap2 = srv.poll(rid2)
+    assert snap2["bucket_rows"] == 0 and snap2["pad_rows"] == 0
+
+    # report audit: the accept event carries the padding block
+    summary = summarize(load_events(
+        os.path.join(root, "serve_telemetry.jsonl")))
+    pad = summary["requests"][rid]["padding"]
+    assert pad["bucket_rows"] == 256 and pad["pad_rows"] == 156
+    assert summary["requests"][rid2]["padding"] is None
+
+
+@pytest.mark.slow
+def test_packed_four_tenants_bit_identical_to_solo(tmp_path):
+    tenants = [
+        dict(rows=100, niter=2, seed=11),
+        dict(rows=110, niter=4, seed=22),
+        dict(rows=120, niter=3, seed=33),
+        dict(rows=130, niter=2, seed=44),
+    ]
+    datas = [_problem(t["rows"], seed=i) for i, t in enumerate(tenants)]
+
+    root = str(tmp_path / "packed")
+    srv = SearchServer(root, capacity=8, workers=1, pack=PackPolicy())
+    rids = []
+    for i, (t, (X, y)) in enumerate(zip(tenants, datas)):
+        rids.append(srv.submit(
+            X, y, options=_options(), niterations=t["niter"],
+            seed=t["seed"], request_id=f"tenant-{i}"))
+    srv.start()
+    try:
+        packed = {rid: srv.wait(rid, timeout=600) for rid in rids}
+    finally:
+        srv.stop(drain=True)
+    assert srv.admission.depth == 0
+    events = load_events(os.path.join(root, "serve_telemetry.jsonl"))
+    launches = [e for e in events if e.get("kind") == "pack_launch"]
+    assert any(len((e.get("detail") or {}).get("tenants", [])) >= 2
+               for e in launches)
+
+    for i, (t, (X, y)) in enumerate(zip(tenants, datas)):
+        assert packed[rids[i]]["state"] == "done"
+        fp = _solo_fingerprint(tmp_path / f"solo{i}", X, y,
+                               t["niter"], t["seed"], f"tenant-{i}")
+        assert packed[rids[i]]["result"]["fingerprint"] == fp, (
+            f"tenant-{i}: packed result differs from solo run")
+
+
+@pytest.mark.slow
+def test_packed_preempt_restart_replay_bit_identity(tmp_path):
+    """Kill (in-process preempt) a PACKED server mid-cohort; the
+    restarted server must finish every tenant bit-identical to an
+    unkilled packed server over the same requests."""
+    tenants = [
+        dict(rows=100, niter=4, seed=5),
+        dict(rows=120, niter=4, seed=7),
+    ]
+    datas = [_problem(t["rows"], seed=i) for i, t in enumerate(tenants)]
+
+    def _submit_all(srv):
+        return [
+            srv.submit(X, y, options=_options(), niterations=t["niter"],
+                       seed=t["seed"], request_id=f"tenant-{i}")
+            for i, (t, (X, y)) in enumerate(zip(tenants, datas))
+        ]
+
+    ref_root = str(tmp_path / "ref")
+    srv = SearchServer(ref_root, capacity=4, workers=1,
+                       pack=PackPolicy())
+    rids = _submit_all(srv)
+    srv.start()
+    ref = {}
+    try:
+        for rid in rids:
+            ref[rid] = srv.wait(rid, timeout=600)
+            assert ref[rid]["state"] == "done"
+    finally:
+        srv.stop(drain=True)
+
+    kill_root = str(tmp_path / "kill")
+    srv = SearchServer(kill_root, capacity=4, workers=1,
+                       pack=PackPolicy())
+    rids = _submit_all(srv)
+    srv.start()
+    ck = os.path.join(kill_root, "requests", rids[0], rids[0],
+                      "search_state.pkl")
+    deadline = time.monotonic() + 300
+    while not os.path.exists(ck) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    srv.stop(drain=False)
+    states = {rid: srv.poll(rid)["state"] for rid in rids}
+    assert any(s != "done" for s in states.values()), states
+
+    # restart: interrupted tenants resume from checkpoints, padding
+    # read back from the journal, cohort re-forms from the queue
+    srv.start()
+    try:
+        for rid in rids:
+            snap = srv.wait(rid, timeout=600)
+            assert snap["state"] == "done", snap
+            assert snap["result"]["fingerprint"] == (
+                ref[rid]["result"]["fingerprint"]
+            ), f"{rid}: resumed packed result differs from unkilled run"
+    finally:
+        srv.stop(drain=True)
+    assert srv.admission.depth == 0

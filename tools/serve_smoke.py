@@ -20,6 +20,11 @@ Scenarios:
 3. **executable-cache**: N same-bucket repeat requests after a cold one
    must all hit the engine cache (repeat hit rate 100%, overall >= 90%),
    and ``telemetry report`` must agree.
+4. **packed-tenancy**: a pack-enabled server takes a storm of
+   near-miss row counts in ONE shape bucket: every request pads to the
+   bucket, at least one launch is genuinely multi-tenant, the padded
+   shapes share the warmed executable (cache hit rate >= 90%), and the
+   drain leaks no admission slot.
 
 The subprocess phases reuse this file: ``--phase run`` creates (or
 recovers) a server over ``--root``, submits the standard request set
@@ -214,6 +219,60 @@ def scenario_cache_hit_rate(out_base: str) -> None:
         f"reported executable-cache hit rate {rate} < 90%")
 
 
+def scenario_packed_tenancy(out_base: str) -> None:
+    import numpy as np
+
+    from symbolicregression_jl_tpu.pack import PackPolicy
+    from symbolicregression_jl_tpu.serve import SearchServer
+    from symbolicregression_jl_tpu.telemetry.report import summarize
+    from symbolicregression_jl_tpu.telemetry.schema import load_events
+
+    def problem(n, seed):
+        r = np.random.default_rng(seed)
+        X = r.uniform(-2.0, 2.0, (n, 2)).astype(np.float32)
+        y = (X[:, 0] * 2.0 + X[:, 1] * X[:, 1]).astype(np.float32)
+        return X, y
+
+    root = os.path.join(out_base, "packed")
+    srv = SearchServer(root, capacity=16, workers=1,
+                       pack=PackPolicy()).start()
+    # warm the executable with ONE cold request first: simultaneous
+    # cold-start tenants race get_engine (build-outside-lock,
+    # serve/cache.py), so a cold storm would double-count misses
+    Xc, yc = problem(200, 42)
+    s = srv.wait(srv.submit(Xc, yc, options=_options(), niterations=2,
+                            seed=100), timeout=600)
+    assert s["state"] == "done", s
+    # the storm: near-miss row counts, all in shape bucket 256 — every
+    # request pads to the bucket and shares the warmed executable
+    n_storm = 10
+    rids = [
+        srv.submit(*problem(190 + 5 * i, seed=i), options=_options(),
+                   niterations=2, seed=200 + i)
+        for i in range(n_storm)
+    ]
+    for rid in rids:
+        s = srv.wait(rid, timeout=600)
+        assert s["state"] == "done", s
+        assert s["pad_rows"] > 0, f"storm request ran unpadded: {s}"
+    srv.stop(drain=True)
+    assert srv.admission.depth == 0, "packed storm leaked admission slots"
+    stats = srv.cache.stats()
+    assert stats["misses"] == 1 and stats["hits"] >= n_storm, stats
+
+    events = load_events(os.path.join(root, "serve_telemetry.jsonl"))
+    multi = [e for e in events
+             if e.get("kind") == "pack_launch"
+             and len((e.get("detail") or {}).get("tenants", [])) >= 2]
+    assert multi, "no multi-tenant pack_launch in the storm"
+    summary = summarize(events)
+    rate = summary["serve"]["cache"]["hit_rate"]
+    assert rate is not None and rate >= 0.9, (
+        f"padded near-miss shapes hit the cache at {rate} < 90%")
+    packing = summary["serve"].get("packing") or {}
+    assert packing.get("multi_tenant_launches", 0) >= 1, packing
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("out_base", nargs="?", default="/tmp/sr_serve_smoke")
@@ -230,7 +289,7 @@ def main() -> int:
     # from a previous run would otherwise replay into this one
     import shutil
 
-    for sub in ("ref", "kill", "overload", "cache"):
+    for sub in ("ref", "kill", "overload", "cache", "packed"):
         shutil.rmtree(os.path.join(args.out_base, sub),
                       ignore_errors=True)
 
@@ -238,6 +297,7 @@ def main() -> int:
         ("kill-restart-replay-bit-identical", scenario_kill_restart_replay),
         ("overload-structured-reject", scenario_overload_reject),
         ("executable-cache-hit-rate", scenario_cache_hit_rate),
+        ("packed-tenancy-storm", scenario_packed_tenancy),
     ]
     for name, fn in scenarios:
         try:
